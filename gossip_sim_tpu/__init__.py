@@ -1,0 +1,20 @@
+"""gossip_sim_tpu — TPU-native Solana gossip push-protocol simulator.
+
+A ground-up JAX/XLA re-design of gregcusack/gossip-sim (see SURVEY.md): the
+per-iteration BFS + prune + rotate loop is recast as batched dense-array
+kernels under ``jit``/``vmap``/``shard_map``, with a faithful seeded CPU
+oracle backend as the parity referee.
+
+Layout:
+  identity   pubkey <-> dense-index mapping, base58, stake buckets
+  ingest     YAML / JSON-RPC account loading (gossip.rs:883-1005)
+  config     Config / Testing / StepSize (gossip.rs:33-133)
+  oracle     CPU oracle backend incl. bit-exact rand/ChaCha port
+  engine     TPU backend: jitted five-verb round, vmapped origins
+  parallel   device mesh + origin/node-axis sharding
+  stats      GossipStats suite (gossip_stats.rs)
+  sinks      InfluxDB line-protocol sink (influx_db.rs)
+  cli        experiment driver + sweep harness (gossip_main.rs)
+"""
+
+__version__ = "0.4.0"

@@ -1,0 +1,105 @@
+"""Simulation configuration, sweep test types, and step sizes.
+
+Mirrors the reference's ``Config`` (gossip.rs:111-133), ``Testing``
+(gossip.rs:33-76) and ``StepSize`` (gossip.rs:78-109).  Flag names and
+defaults are the compatibility contract (gossip_main.rs:53-241).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+
+
+class Testing(enum.Enum):
+    ACTIVE_SET_SIZE = "active-set-size"
+    PUSH_FANOUT = "push-fanout"
+    MIN_INGRESS_NODES = "min-ingress-nodes"
+    PRUNE_STAKE_THRESHOLD = "prune-stake-threshold"
+    ORIGIN_RANK = "origin-rank"
+    FAIL_NODES = "fail-nodes"
+    ROTATE_PROBABILITY = "rotate-probability"
+    NO_TEST = "no-test"
+
+    def __str__(self):
+        # Display names match the reference (gossip.rs:45-58).
+        return {
+            Testing.ACTIVE_SET_SIZE: "ActiveSetSize",
+            Testing.PUSH_FANOUT: "PushFanout",
+            Testing.MIN_INGRESS_NODES: "MinIngressNodes",
+            Testing.PRUNE_STAKE_THRESHOLD: "PruneStakeThreshold",
+            Testing.ORIGIN_RANK: "OriginRank",
+            Testing.FAIL_NODES: "FailNodes",
+            Testing.ROTATE_PROBABILITY: "RotateProbability",
+            Testing.NO_TEST: "NoTest",
+        }[self]
+
+    @classmethod
+    def parse(cls, s: str) -> "Testing":
+        for t in cls:
+            if t.value == s:
+                return t
+        raise ValueError(f"Invalid test type: {s}")
+
+
+@dataclass(frozen=True)
+class StepSize:
+    """Integer-or-float sweep step (gossip.rs:78-109)."""
+
+    value: float
+    is_integer: bool
+
+    @classmethod
+    def parse(cls, s: str) -> "StepSize":
+        try:
+            return cls(value=int(s), is_integer=True)
+        except ValueError:
+            return cls(value=float(s), is_integer=False)
+
+    def as_int(self) -> int:
+        return int(self.value)
+
+    def as_float(self) -> float:
+        return float(self.value)
+
+    def __str__(self):
+        return str(int(self.value)) if self.is_integer else str(self.value)
+
+
+@dataclass
+class Config:
+    """Flat simulation config (gossip.rs:111-133). Defaults from
+    gossip_main.rs:90,97,104,113,124,135,142,150-169,204-224."""
+
+    gossip_push_fanout: int = 6
+    gossip_active_set_size: int = 12
+    gossip_iterations: int = 1
+    accounts_from_file: bool = False
+    account_file: str = ""
+    origin_rank: int = 1
+    probability_of_rotation: float = 0.013333
+    prune_stake_threshold: float = 0.15
+    min_ingress_nodes: int = 2
+    filter_zero_staked_nodes: bool = False
+    num_buckets_for_stranded_node_hist: int = 10
+    num_buckets_for_message_hist: int = 5
+    num_buckets_for_hops_stats_hist: int = 15
+    fraction_to_fail: float = 0.1
+    when_to_fail: int = 0
+    test_type: Testing = Testing.NO_TEST
+    num_simulations: int = 1
+    step_size: StepSize = field(default_factory=lambda: StepSize(1, True))
+    warm_up_rounds: int = 200
+    print_stats: bool = False
+
+    # TPU-framework extensions (not in the reference):
+    backend: str = "tpu"            # "tpu" | "oracle"
+    seed: int = 42                  # deterministic by construction
+    num_synthetic_nodes: int = 0    # >0: synthetic cluster instead of file/RPC
+    all_origins: bool = False       # vmap the origin axis (north-star mode)
+    origin_batch: int = 0           # origins per device batch (0 = auto)
+    checkpoint_path: str = ""       # save/resume sim state
+    mesh_devices: int = 0           # 0 = all available devices
+
+    def stepped(self, **kw) -> "Config":
+        return replace(self, **kw)

@@ -1,0 +1,51 @@
+"""Shared constants.
+
+TPU-native re-implementation of the reference's shared definitions
+(reference: lib.rs:8-17, push_active_set.rs:11, received_cache.rs:21,78,81,
+gossip.rs:31).
+"""
+
+# Solana native-token scale (reference: solana_sdk::native_token::LAMPORTS_PER_SOL,
+# used at push_active_set.rs:191).
+LAMPORTS_PER_SOL = 1_000_000_000
+
+# Number of stake buckets in a push active set (reference: push_active_set.rs:11).
+NUM_PUSH_ACTIVE_SET_ENTRIES = 25
+
+# Received-cache gating / scoring constants (reference: received_cache.rs:21,78,81).
+MIN_NUM_UPSERTS = 20
+RECEIVED_CACHE_CAPACITY = 50
+NUM_DUPS_THRESHOLD = 2
+
+# CRDS unique pubkey capacity; the received cache is sized 2x this
+# (reference: gossip.rs:31,906).
+CRDS_UNIQUE_PUBKEY_CAPACITY = 8192
+
+# Sentinel distance for unreached nodes (reference uses u64::MAX, gossip.rs:490).
+UNREACHED = (1 << 64) - 1
+
+# RPC endpoints (reference: lib.rs:8-9).
+API_MAINNET_BETA = "https://api.mainnet-beta.solana.com"
+API_TESTNET = "https://api.testnet.solana.com"
+
+# Influx endpoints (reference: lib.rs:11-12).
+INFLUX_INTERNAL_METRICS = "https://internal-metrics.solana.com:8086"
+INFLUX_LOCALHOST = "http://localhost:8086"
+
+# Histogram bounds (reference: lib.rs:14-17).
+VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS = 50
+AGGREGATE_HOPS_FAIL_NODES_HISTOGRAM_UPPER_BOUND = 40.0
+AGGREGATE_HOPS_MIN_INGRESS_NODES_HISTOGRAM_UPPER_BOUND = 50
+STANDARD_HISTOGRAM_UPPER_BOUND = 30
+
+
+def get_json_rpc_url(url: str) -> str:
+    """Resolve RPC URL monikers (reference: lib.rs:88-94)."""
+    return {"m": API_MAINNET_BETA, "mainnet-beta": API_MAINNET_BETA,
+            "t": API_TESTNET, "testnet": API_TESTNET}.get(url, url)
+
+
+def get_influx_url(url: str) -> str:
+    """Resolve Influx URL monikers (reference: lib.rs:96-102)."""
+    return {"i": INFLUX_INTERNAL_METRICS, "internal-metrics": INFLUX_INTERNAL_METRICS,
+            "l": INFLUX_LOCALHOST, "localhost": INFLUX_LOCALHOST}.get(url, url)

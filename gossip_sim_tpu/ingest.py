@@ -1,0 +1,84 @@
+"""Data ingestion: YAML account files, Solana JSON-RPC, synthetic clusters.
+
+Reference: gossip.rs:883-1005 (cluster factories), gossip_main.rs:304-328
+(YAML read), write_accounts_main.rs:118-125 (YAML write).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+
+import yaml
+
+from .constants import LAMPORTS_PER_SOL
+from .identity import Pubkey, pubkey_new_unique
+
+log = logging.getLogger(__name__)
+
+
+def load_accounts_yaml(path: str) -> dict:
+    """Read a {pubkey_str: stake} YAML account file (gossip_main.rs:304-318)."""
+    with open(path) as f:
+        accounts = yaml.safe_load(f) or {}
+    log.info("%s accounts read in", len(accounts))
+    return {Pubkey.from_string(k): int(v) for k, v in accounts.items()}
+
+
+def write_accounts_yaml(path: str, accounts: dict) -> None:
+    """Write {pubkey: stake} as YAML (write_accounts_main.rs:118-125)."""
+    out = {(pk.to_string() if isinstance(pk, Pubkey) else str(pk)): int(stake)
+           for pk, stake in accounts.items()}
+    with open(path, "w") as f:
+        yaml.safe_dump(out, f, default_flow_style=False)
+
+
+def fetch_vote_accounts_rpc(json_rpc_url: str, timeout: float = 30.0) -> dict:
+    """Pull vote accounts via ``getVoteAccounts`` and aggregate activated
+    stake per node pubkey over current + delinquent accounts
+    (gossip.rs:936-967; keeps unstaked delinquents, finalized commitment)."""
+    payload = {
+        "jsonrpc": "2.0",
+        "id": 1,
+        "method": "getVoteAccounts",
+        "params": [{"commitment": "finalized", "keepUnstakedDelinquents": True}],
+    }
+    req = urllib.request.Request(
+        json_rpc_url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        result = json.load(resp)["result"]
+    log.info("num of vote accounts: %s",
+             len(result["current"]) + len(result["delinquent"]))
+    stakes: dict = {}
+    for info in list(result["current"]) + list(result["delinquent"]):
+        key = info["nodePubkey"]
+        stakes[key] = stakes.get(key, 0) + int(info["activatedStake"])
+    return {Pubkey.from_string(k): v for k, v in stakes.items()}
+
+
+def filter_accounts(accounts: dict, filter_zero_staked: bool) -> dict:
+    """Optionally drop zero-staked nodes (gossip.rs:892-894)."""
+    if not filter_zero_staked:
+        return dict(accounts)
+    return {pk: s for pk, s in accounts.items() if s != 0}
+
+
+def synthetic_accounts(num_nodes: int, rng, max_stake_sol: int = 1 << 20) -> dict:
+    """Deterministic synthetic cluster: counter pubkeys + uniform stakes in
+    [1, max_stake_sol * LAMPORTS_PER_SOL) — the reference test-fixture recipe
+    (gossip.rs:1044-1050)."""
+    max_stake = max_stake_sol * LAMPORTS_PER_SOL
+    return {pubkey_new_unique(): rng.gen_range_u64(1, max_stake)
+            for _ in range(num_nodes)}
+
+
+def log_cluster_summary(accounts: dict) -> None:
+    """(gossip.rs:914-923)"""
+    staked = sum(1 for s in accounts.values() if s != 0)
+    log.info("num of staked nodes in cluster: %s", staked)
+    log.info("num of cluster nodes: %s", len(accounts))
+    log.info("cluster stake: %s", sum(accounts.values()))

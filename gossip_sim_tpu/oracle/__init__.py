@@ -1,0 +1,20 @@
+"""CPU oracle backend: faithful, seeded, dictionary-based reference semantics.
+
+This backend is the parity referee for the TPU engine (SURVEY.md build plan
+step 2); it mirrors /root/reference/src semantics including the exact
+ChaCha/rand RNG stream (see ``rustrng``).
+"""
+
+from .cluster import Cluster, Node, make_cluster_nodes
+from .rmr import RelativeMessageRedundancy
+from .rustrng import ChaChaRng
+from .weighted_shuffle import WeightedShuffle
+
+__all__ = [
+    "ChaChaRng",
+    "Cluster",
+    "Node",
+    "RelativeMessageRedundancy",
+    "WeightedShuffle",
+    "make_cluster_nodes",
+]

@@ -1,0 +1,98 @@
+"""Push active set: 25 stake-bucketed entries of push peers with prune filters.
+
+Oracle (CPU) equivalent of the reference's ``PushActiveSet`` /
+``PushActiveSetEntry`` (push_active_set.rs:24-187) with one documented
+divergence: the per-peer pruned-origin *bloom filter* (false-positive rate 0.1,
+<=32768 bits, push_active_set.rs:122-123) is replaced by an exact set, so the
+oracle never over-prunes due to bloom false positives.  Everything else —
+bucket selection by min(stake(self), stake(origin)), insertion-order iteration,
+self-seeded filters (a peer never receives messages originating from itself,
+push_active_set.rs:179), incremental rotation with oldest-first eviction
+(push_active_set.rs:153-186) — matches the reference bit-for-bit under the
+same RNG stream.
+"""
+
+from __future__ import annotations
+
+from ..constants import NUM_PUSH_ACTIVE_SET_ENTRIES
+from ..identity import get_stake_bucket
+from .weighted_shuffle import WeightedShuffle
+
+
+class PushActiveSetEntry:
+    """Insertion-ordered map: peer pubkey -> set of pruned origins."""
+
+    def __init__(self):
+        self.peers = {}  # Pubkey -> set(Pubkey); python dicts preserve insertion order
+
+    def __len__(self):
+        return len(self.peers)
+
+    def get_nodes(self, origin, force_push=None):
+        """Yield peers (insertion order) whose filter does not contain origin
+        (push_active_set.rs:128-141)."""
+        for node, pruned in self.peers.items():
+            if origin not in pruned or (force_push is not None and force_push(node)):
+                yield node
+
+    def prune(self, node, origin):
+        """Add origin to node's pruned-filter if node is a current peer
+        (push_active_set.rs:143-151)."""
+        s = self.peers.get(node)
+        if s is not None:
+            s.add(origin)
+
+    def rotate(self, rng, size, nodes, weights):
+        """Incremental rotation (push_active_set.rs:153-186).
+
+        Walk the weighted shuffle, inserting unseen peers (filter self-seeded
+        with the peer's own key) until len exceeds ``size``; then evict oldest
+        entries down to ``size``.  With a full entry this swaps in exactly one
+        new peer and evicts the oldest.
+        """
+        for idx in WeightedShuffle(weights).shuffle(rng):
+            if len(self.peers) > size:
+                break
+            node = nodes[idx]
+            if node in self.peers:
+                continue
+            self.peers[node] = {node}  # self-seed: never push origin==peer to peer
+        while len(self.peers) > size:
+            oldest = next(iter(self.peers))
+            del self.peers[oldest]
+
+
+class PushActiveSet:
+    """25 stake-bucket entries (push_active_set.rs:24-119)."""
+
+    def __init__(self):
+        self.entries = [PushActiveSetEntry() for _ in range(NUM_PUSH_ACTIVE_SET_ENTRIES)]
+
+    def _entry(self, stake):
+        return self.entries[get_stake_bucket(stake)]
+
+    def get_nodes(self, pubkey, origin, stakes, force_push=None):
+        """Peers to push to for a value owned by ``origin``
+        (push_active_set.rs:38-52): bucket by min(stake(self), stake(origin))."""
+        stake = min(stakes.get(pubkey, 0), stakes.get(origin, 0))
+        return self._entry(stake).get_nodes(origin, force_push)
+
+    def prune(self, pubkey, node, origins, stakes):
+        """Stop pushing messages from ``origins`` to ``node``
+        (push_active_set.rs:56-71)."""
+        my_stake = stakes.get(pubkey, 0)
+        for origin in origins:
+            if origin == pubkey:
+                continue
+            stake = min(my_stake, stakes.get(origin, 0))
+            self._entry(stake).prune(node, origin)
+
+    def rotate(self, rng, size, nodes, stakes):
+        """Re-sample every bucket entry (push_active_set.rs:73-114).
+
+        For entry k, candidate j's weight is (min(bucket_j, k) + 1)^2.
+        """
+        buckets = [get_stake_bucket(stakes.get(n, 0)) for n in nodes]
+        for k, entry in enumerate(self.entries):
+            weights = [(min(b, k) + 1) ** 2 for b in buckets]
+            entry.rotate(rng, size, nodes, weights)

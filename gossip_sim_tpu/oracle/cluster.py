@@ -1,0 +1,228 @@
+"""CPU oracle simulation engine: the five-verb gossip round.
+
+Faithful Python equivalent of the reference's ``Cluster`` / ``Node``
+(gossip.rs:135-856).  Per iteration:
+
+  1. ``run_gossip``      — BFS from the origin through each node's active set,
+                           truncated to push_fanout (gossip.rs:494-615).
+  2. ``consume_messages`` — each destination ranks inbound peers by
+                           (hops, pubkey-string) and records them
+                           (gossip.rs:618-653).
+  3. ``send_prunes``      — upsert-gated prune decisions (gossip.rs:657-697).
+  4. ``prune_connections``— prunees add the pruner to their filters
+                           (gossip.rs:701-737).
+  5. ``chance_to_rotate`` — Bernoulli(p) incremental active-set rotation
+                           (gossip.rs:739-754).
+
+Divergence from the reference (documented, deliberate): all randomness flows
+through one explicit seeded rng — the reference's entropy-seeded per-thread
+RNGs (gossip.rs:747-753, gossip_main.rs:269) make production runs
+unreproducible and are not carried forward.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from ..constants import CRDS_UNIQUE_PUBKEY_CAPACITY, UNREACHED
+from .active_set import PushActiveSet
+from .received_cache import ReceivedCache
+from .rmr import RelativeMessageRedundancy
+
+
+class Node:
+    """Per-validator state (gossip.rs:774-856)."""
+
+    def __init__(self, pubkey, stake):
+        self.pubkey = pubkey
+        self.stake = stake
+        self.active_set = PushActiveSet()
+        self.received_cache = ReceivedCache(2 * CRDS_UNIQUE_PUBKEY_CAPACITY)
+        self.failed = False
+
+    def rotate_active_set(self, rng, active_set_size, stakes):
+        """Re-sample the active set from all other nodes (gossip.rs:815-842).
+
+        Candidates are always sorted (by pubkey bytes) for determinism — the
+        reference sorts only under ``test`` (gossip.rs:833-835); sorted order
+        is the canonical order here.
+        """
+        candidates = sorted(pk for pk in stakes if pk != self.pubkey)
+        self.active_set.rotate(rng, active_set_size, candidates, stakes)
+
+    def initialize_gossip(self, rng, stakes, active_set_size):
+        self.rotate_active_set(rng, active_set_size, stakes)
+
+    def fail_node(self):
+        self.failed = True
+
+
+class Cluster:
+    """Per-iteration simulation state + the five protocol verbs
+    (gossip.rs:135-772)."""
+
+    def __init__(self, push_fanout):
+        self.gossip_push_fanout = push_fanout
+        self.visited = set()
+        self.distances = {}
+        self.orders = {}       # dest -> {src -> hops}
+        self.mst = {}          # src -> set(dest) first-delivery edges
+        self.pushes = {}       # src -> set(dest) all push edges
+        self.prunes = {}       # pruner -> {prunee -> [origins]}
+        self.rmr = RelativeMessageRedundancy()
+        self.failed_nodes = set()
+        self.total_prunes = 0
+        self.egress_message_count = {}
+        self.ingress_message_count = {}
+        self.prune_messages_sent = {}
+
+    def _clear(self, stakes):
+        self.visited.clear()
+        self.distances = {pk: UNREACHED for pk in stakes}
+        self.orders.clear()
+        self.mst.clear()
+        self.pushes.clear()
+        self.prunes.clear()
+        self.rmr.reset()
+        self.total_prunes = 0
+        self.egress_message_count.clear()
+        self.ingress_message_count.clear()
+        self.prune_messages_sent.clear()
+
+    # -- verb 1: push/diffuse ------------------------------------------------
+
+    def run_gossip(self, origin_pubkey, stakes, node_map):
+        """BFS through active sets truncated to fanout (gossip.rs:494-615)."""
+        self._clear(stakes)
+        self.distances[origin_pubkey] = 0
+        self.visited.add(origin_pubkey)
+        self.rmr.increment_n()
+        queue = deque([origin_pubkey])
+        fanout = self.gossip_push_fanout
+        while queue:
+            current = queue.popleft()
+            dist = self.distances[current]
+            node = node_map[current]
+            self.pushes[current] = set()
+            self.egress_message_count[current] = 0
+            peers = node.active_set.get_nodes(current, origin_pubkey, stakes)
+            for _, neighbor in zip(range(fanout), peers):
+                if node_map[neighbor].failed:
+                    continue  # failed targets consume a fanout slot, nothing else
+                self.pushes[current].add(neighbor)
+                self.egress_message_count[current] += 1
+                self.ingress_message_count[neighbor] = (
+                    self.ingress_message_count.get(neighbor, 0) + 1)
+                # The reference checks here that the neighbor hasn't pruned us
+                # (gossip.rs:564-568), but prunes are cleared at round start so
+                # the check is vacuous; the active-set filters are the real
+                # enforcement and are exercised by the golden tests.
+                self.rmr.increment_m()
+                if neighbor not in self.visited:
+                    self.visited.add(neighbor)
+                    self.distances[neighbor] = dist + 1
+                    queue.append(neighbor)
+                    self.mst.setdefault(current, set()).add(neighbor)
+                    self.rmr.increment_n()
+                self.orders.setdefault(neighbor, {})[current] = dist + 1
+
+    # -- verb 2: consume -----------------------------------------------------
+
+    def consume_messages(self, origin, nodes):
+        """Rank inbound peers by (hops, pubkey-string) and record
+        (gossip.rs:618-653)."""
+        for node in nodes:
+            if node.pubkey == origin:
+                continue
+            sources = self.orders.get(node.pubkey)
+            if not sources:
+                continue
+            ranked = sorted(sources.items(),
+                            key=lambda kv: (kv[1], kv[0].to_string()))
+            for num_dups, (src, _hops) in enumerate(ranked):
+                node.received_cache.record(origin, src, num_dups)
+
+    # -- verb 3: prune decisions ---------------------------------------------
+
+    def send_prunes(self, origin, nodes, prune_stake_threshold,
+                    min_ingress_nodes, stakes):
+        """Each node decides whom to prune for this origin (gossip.rs:657-697).
+        Prune messages count toward RMR's m (gossip.rs:684-687)."""
+        for node in nodes:
+            pruned = node.received_cache.prune(
+                node.pubkey, origin, prune_stake_threshold,
+                min_ingress_nodes, stakes)
+            prunes = {peer: [origin] for peer in pruned}
+            for origins in prunes.values():
+                self.rmr.increment_m_by(len(origins))
+            self.prunes[node.pubkey] = prunes
+
+    # -- verb 4: prune application -------------------------------------------
+
+    def prune_connections(self, node_map, stakes):
+        """Prunees add (pruner, origin) to their active-set filters
+        (gossip.rs:701-737)."""
+        for pruner, prunes in self.prunes.items():
+            if prunes:
+                self.total_prunes += len(prunes)
+            count = self.prune_messages_sent.setdefault(pruner, 0)
+            for prunee, origins in prunes.items():
+                node = node_map.get(prunee)
+                if node is not None:
+                    node.active_set.prune(prunee, pruner, origins, stakes)
+                count += len(origins)
+            self.prune_messages_sent[pruner] = count
+
+    # -- verb 5: rotation ----------------------------------------------------
+
+    def chance_to_rotate(self, rng, nodes, active_set_size, stakes,
+                         probability_of_rotation):
+        """Bernoulli(p) incremental rotation per node (gossip.rs:739-754)."""
+        for node in nodes:
+            if rng.gen_f64() < probability_of_rotation:
+                node.rotate_active_set(rng, active_set_size, stakes)
+
+    # -- fault injection -----------------------------------------------------
+
+    def fail_nodes(self, fraction_to_fail, nodes, rng):
+        """Fail a random fraction of nodes permanently (gossip.rs:756-771)."""
+        total = int(fraction_to_fail * len(nodes))
+        order = list(range(len(nodes)))
+        # Fisher-Yates driven by the explicit rng (reference shuffles with
+        # thread_rng, gossip.rs:763-764).
+        for i in range(len(order) - 1, 0, -1):
+            j = rng.gen_range_u64(0, i + 1)
+            order[i], order[j] = order[j], order[i]
+        for i in order[:total]:
+            nodes[i].fail_node()
+            self.failed_nodes.add(nodes[i].pubkey)
+
+    # -- observers -----------------------------------------------------------
+
+    def coverage(self, stakes):
+        """(fraction visited, #unvisited) (gossip.rs:321-327)."""
+        return (len(self.visited) / len(stakes),
+                len(stakes) - len(self.visited))
+
+    def stranded_nodes(self):
+        """Unreached and not failed (gossip.rs:329-345)."""
+        return [pk for pk, d in self.distances.items()
+                if d == UNREACHED and pk not in self.failed_nodes]
+
+    def relative_message_redundancy(self):
+        """Memoized RMR accessor (gossip.rs:435-443)."""
+        if self.rmr.rmr == 0.0:
+            return self.rmr.calculate()
+        return self.rmr.rmr, self.rmr.m, self.rmr.n
+
+    def clear_message_counts(self):
+        for d in (self.egress_message_count, self.ingress_message_count,
+                  self.prune_messages_sent):
+            for k in d:
+                d[k] = 0
+
+
+def make_cluster_nodes(accounts, filter_zero_staked=False):
+    """Build Node objects from {Pubkey: stake} (gossip.rs:883-925)."""
+    return [Node(pk, stake) for pk, stake in accounts.items()
+            if not filter_zero_staked or stake != 0]

@@ -1,0 +1,95 @@
+"""Per-origin inbound-peer scoring and prune decisions.
+
+Oracle equivalent of the reference's ``ReceivedCache`` /
+``ReceivedCacheEntry`` (received_cache.rs:11-132):
+
+  * ``record(origin, node, num_dups)``: first delivery (num_dups == 0) bumps
+    the upsert count; timely deliveries (num_dups < 2) bump the peer's score
+    (inserting it unconditionally); late deliveries only reserve a slot while
+    under the 50-entry cap (received_cache.rs:83-98).
+  * ``prune(...)``: gated on >= 20 upserts; on firing, the entry's state is
+    consumed (score reset — the reference's ``mem::take``,
+    received_cache.rs:55) and peers are sorted by (score, stake) descending;
+    the first ``min_ingress_nodes`` survive, plus peers until the running
+    (exclusive) stake prefix-sum reaches
+    ``stake_threshold * min(stake(self), stake(origin))``; the rest are pruned
+    (received_cache.rs:100-131).
+
+Divergence (documented): on exact (score, stake) ties the reference's unstable
+sort is nondeterministic; we tie-break by pubkey bytes ascending.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..constants import (MIN_NUM_UPSERTS, NUM_DUPS_THRESHOLD,
+                         RECEIVED_CACHE_CAPACITY)
+
+
+class ReceivedCacheEntry:
+    __slots__ = ("nodes", "num_upserts")
+
+    def __init__(self):
+        self.nodes = {}  # Pubkey -> score
+        self.num_upserts = 0
+
+    def record(self, node, num_dups):
+        if num_dups == 0:
+            self.num_upserts += 1
+        if num_dups < NUM_DUPS_THRESHOLD:
+            self.nodes[node] = self.nodes.get(node, 0) + 1
+        elif len(self.nodes) < RECEIVED_CACHE_CAPACITY:
+            self.nodes.setdefault(node, 0)
+
+    def prune(self, pubkey, origin, stake_threshold, min_ingress_nodes, stakes):
+        """Yield pruned peers (received_cache.rs:100-131). Consumes self's state."""
+        min_stake = min(stakes.get(pubkey, 0), stakes.get(origin, 0))
+        # f64 multiply then truncation to u64, as in the reference.
+        min_ingress_stake = int(float(min_stake) * stake_threshold)
+        ranked = sorted(
+            ((node, score, stakes.get(node, 0)) for node, score in self.nodes.items()),
+            key=lambda t: (-t[1], -t[2], t[0].raw),
+        )
+        pruned = []
+        cum = 0
+        for idx, (node, _score, stake) in enumerate(ranked):
+            old = cum
+            cum += stake
+            if idx < min_ingress_nodes:
+                continue
+            if old < min_ingress_stake:
+                continue
+            pruned.append(node)
+        return pruned
+
+
+class ReceivedCache:
+    """LRU of per-origin entries (received_cache.rs:11-63)."""
+
+    def __init__(self, capacity):
+        self.capacity = capacity
+        self.cache = OrderedDict()  # origin -> ReceivedCacheEntry, LRU order
+
+    def record(self, origin, node, num_dups):
+        entry = self.cache.get(origin)
+        if entry is not None:
+            self.cache.move_to_end(origin)  # LruCache::get_mut promotes
+        else:
+            entry = ReceivedCacheEntry()
+            self.cache[origin] = entry
+            while len(self.cache) > self.capacity:
+                self.cache.popitem(last=False)
+        entry.record(node, num_dups)
+
+    def prune(self, pubkey, origin, stake_threshold, min_ingress_nodes, stakes):
+        """Upsert-gated prune; resets the entry's scores when the gate passes
+        (received_cache.rs:38-63). Uses peek (no LRU promotion)."""
+        entry = self.cache.get(origin)
+        if entry is None or entry.num_upserts < MIN_NUM_UPSERTS:
+            return []
+        taken, fresh = entry, ReceivedCacheEntry()
+        self.cache[origin] = fresh  # mem::take: reset in place, keep LRU slot
+        return [n for n in taken.prune(pubkey, origin, stake_threshold,
+                                       min_ingress_nodes, stakes)
+                if n != origin]

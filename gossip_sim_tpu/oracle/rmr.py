@@ -1,0 +1,37 @@
+"""Relative message redundancy: rmr = m / (n - 1) - 1.
+
+m counts push messages *and* prune messages (gossip.rs:571,684-687);
+n counts nodes that received the message, including the origin
+(gossip.rs:508,594).  Reference: gossip_stats.rs:466-547.
+"""
+
+from __future__ import annotations
+
+
+class RelativeMessageRedundancy:
+    __slots__ = ("m", "n", "rmr")
+
+    def __init__(self):
+        self.m = 0
+        self.n = 0
+        self.rmr = 0.0
+
+    def increment_m(self):
+        self.m += 1
+
+    def increment_m_by(self, amount):
+        self.m += amount
+
+    def increment_n(self):
+        self.n += 1
+
+    def reset(self):
+        self.m = 0
+        self.n = 0
+        self.rmr = 0.0
+
+    def calculate(self):
+        if self.n == 0:
+            raise ZeroDivisionError("RMR: n is 0")
+        self.rmr = self.m / (self.n - 1) - 1.0
+        return self.rmr, self.m, self.n

@@ -1,0 +1,70 @@
+"""Stake-weighted sampling without replacement (Fenwick-tree successive sampling).
+
+Equivalent of ``solana_gossip::weighted_shuffle::WeightedShuffle`` as consumed
+by the reference active-set rotation (push_active_set.rs:164).  Semantics:
+
+  * ``shuffle(rng)`` lazily yields indices; each yield draws exactly one
+    ``gen_range_u64(0, remaining_sum)`` from the rng and removes the selected
+    weight (successive / Plackett-Luce sampling).
+  * Zero weights are never selected (rotation weights are always >= 1,
+    push_active_set.rs:109).
+
+RNG consumption matches the reference exactly (one uniform draw per yielded
+index), so a ChaCha-seeded run reproduces the reference's draws bit-for-bit.
+"""
+
+from __future__ import annotations
+
+
+class WeightedShuffle:
+    def __init__(self, weights):
+        n = len(weights)
+        self.size = n + 1
+        self.tree = [0] * self.size  # 1-based Fenwick tree
+        self.sum = 0
+        for k, w in enumerate(weights, start=1):
+            w = int(w)
+            if w < 0:
+                continue
+            self.sum += w
+            while k < self.size:
+                self.tree[k] += w
+                k += k & -k
+        # Highest power of two <= size, for the Fenwick descend.
+        self.top = 1 << (self.size.bit_length() - 1)
+
+    def _cumsum(self, k: int) -> int:
+        out = 0
+        while k > 0:
+            out += self.tree[k]
+            k -= k & -k
+        return out
+
+    def _search(self, val: int):
+        """Smallest 1-based k with cumsum(k) > val; returns (k, weight_k)."""
+        pos = 0
+        rem = val
+        step = self.top
+        while step > 0:
+            nxt = pos + step
+            if nxt < self.size and self.tree[nxt] <= rem:
+                rem -= self.tree[nxt]
+                pos = nxt
+            step >>= 1
+        k = pos + 1
+        weight = self._cumsum(k) - self._cumsum(k - 1)
+        return k, weight
+
+    def _remove(self, k: int, weight: int):
+        self.sum -= weight
+        while k < self.size:
+            self.tree[k] -= weight
+            k += k & -k
+
+    def shuffle(self, rng):
+        """Lazily yield 0-based indices in successive-sampling order."""
+        while self.sum > 0:
+            val = rng.gen_range_u64(0, self.sum)
+            k, w = self._search(val)
+            self._remove(k, w)
+            yield k - 1

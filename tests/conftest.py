@@ -1,0 +1,25 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip sharding is validated on virtual CPU devices (no TPU pod needed);
+the driver separately dry-runs the multichip path via __graft_entry__.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from gossip_sim_tpu.identity import reset_unique_pubkeys  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pubkey_counter():
+    """Reference test fixtures assume the Pubkey::new_unique counter starts
+    at 1 in each test."""
+    reset_unique_pubkeys()
+    yield

@@ -1,0 +1,91 @@
+"""Exact ports of the reference's active-set golden tests
+(push_active_set.rs:228-400), reproduced bit-for-bit through the
+ChaCha/WeightedShuffle parity stack."""
+
+from gossip_sim_tpu.constants import LAMPORTS_PER_SOL
+from gossip_sim_tpu.identity import pubkey_new_unique
+from gossip_sim_tpu.oracle.active_set import PushActiveSet, PushActiveSetEntry
+from gossip_sim_tpu.oracle.rustrng import ChaChaRng
+
+MAX_STAKE = (1 << 20) * LAMPORTS_PER_SOL
+
+
+def test_push_active_set():
+    # push_active_set.rs:228-339
+    rng = ChaChaRng.from_seed_byte(189)
+    pubkey = pubkey_new_unique()
+    nodes = [pubkey_new_unique() for _ in range(20)]
+    stakes = {n: rng.gen_range_u64(1, MAX_STAKE) for n in nodes}
+    stakes[pubkey] = rng.gen_range_u64(1, MAX_STAKE)
+    aset = PushActiveSet()
+    assert all(len(e) == 0 for e in aset.entries)
+    aset.rotate(rng, 5, nodes, stakes)
+    assert all(len(e) == 5 for e in aset.entries)
+    # every entry's filter already prunes the peer's own key (self-seed)
+    for entry in aset.entries:
+        for node, pruned in entry.peers.items():
+            assert node in pruned
+
+    other, origin = nodes[5], nodes[17]
+
+    def got(origin_pk):
+        return [nodes.index(n) for n in aset.get_nodes(pubkey, origin_pk, stakes)]
+
+    assert got(origin) == [13, 5, 18, 16, 0]
+    assert got(other) == [13, 18, 16, 0]
+
+    aset.prune(pubkey, nodes[5], [origin], stakes)
+    aset.prune(pubkey, nodes[3], [origin], stakes)
+    aset.prune(pubkey, nodes[16], [origin], stakes)
+    assert got(origin) == [13, 18, 0]
+    assert got(other) == [13, 18, 16, 0]
+
+    aset.rotate(rng, 7, nodes, stakes)
+    assert all(len(e) == 7 for e in aset.entries)
+    assert got(origin) == [18, 0, 7, 15, 11]
+    assert got(other) == [18, 16, 0, 7, 15, 11]
+
+    origins = [origin, other]
+    aset.prune(pubkey, nodes[18], origins, stakes)
+    aset.prune(pubkey, nodes[0], origins, stakes)
+    aset.prune(pubkey, nodes[15], origins, stakes)
+    assert got(origin) == [7, 11]
+    assert got(other) == [16, 7, 11]
+
+
+def test_push_active_set_entry():
+    # push_active_set.rs:341-400
+    rng = ChaChaRng.from_seed_byte(147)
+    nodes = [pubkey_new_unique() for _ in range(20)]
+    weights = [rng.gen_range_u64(1, 1000) for _ in range(20)]
+    entry = PushActiveSetEntry()
+    entry.rotate(rng, 5, nodes, weights)
+    assert len(entry) == 5
+    keys = [nodes[16], nodes[11], nodes[17], nodes[14], nodes[5]]
+    assert list(entry.peers) == keys
+    for origin in nodes:
+        if origin not in keys:
+            assert list(entry.get_nodes(origin)) == keys
+        else:
+            assert list(entry.get_nodes(origin, lambda n: True)) == keys
+            assert list(entry.get_nodes(origin)) == \
+                [k for k in keys if k != origin]
+    for node, pruned in entry.peers.items():
+        assert node in pruned
+    # prune excludes peers from get
+    origin = nodes[3]
+    entry.prune(nodes[11], origin)
+    entry.prune(nodes[14], origin)
+    entry.prune(nodes[19], origin)  # not a peer: no-op
+    assert list(entry.get_nodes(origin, lambda n: True)) == keys
+    assert list(entry.get_nodes(origin)) == \
+        [k for k in keys if k not in (nodes[11], nodes[14])]
+    # rotation swaps in new peers, evicting oldest-first
+    entry.rotate(rng, 5, nodes, weights)
+    assert list(entry.peers) == [nodes[11], nodes[17], nodes[14],
+                                 nodes[5], nodes[7]]
+    entry.rotate(rng, 6, nodes, weights)
+    assert list(entry.peers) == [nodes[17], nodes[14], nodes[5],
+                                 nodes[7], nodes[1], nodes[13]]
+    entry.rotate(rng, 4, nodes, weights)
+    assert list(entry.peers) == [nodes[5], nodes[7], nodes[1], nodes[13]]

@@ -1,0 +1,62 @@
+"""Identity layer golden tests.
+
+Ports the reference's ``test_get_stake_bucket`` (push_active_set.rs:205-226)
+and pins the Pubkey::new_unique/base58 fixture strings used throughout the
+reference test suite (gossip_stats.rs:2024-2027 etc.).
+"""
+
+import numpy as np
+
+from gossip_sim_tpu.constants import LAMPORTS_PER_SOL
+from gossip_sim_tpu.identity import (NodeIndex, Pubkey, b58decode, b58encode,
+                                     get_stake_bucket, pubkey_new_unique,
+                                     stake_buckets_array)
+
+U64_MAX = (1 << 64) - 1
+
+
+def test_get_stake_bucket():
+    # push_active_set.rs:205-226
+    assert get_stake_bucket(0) == 0
+    buckets = [0, 1, 2, 2, 3, 3, 3, 3, 4, 4, 4, 4, 4, 4, 4, 4, 5, 5]
+    for k, bucket in enumerate(buckets):
+        assert get_stake_bucket(k * LAMPORTS_PER_SOL) == bucket
+    for stake, bucket in [(4_194_303, 22), (4_194_304, 23),
+                          (8_388_607, 23), (8_388_608, 24)]:
+        assert get_stake_bucket(stake * LAMPORTS_PER_SOL) == bucket
+    assert get_stake_bucket(U64_MAX) == 24
+
+
+def test_stake_buckets_array_matches_scalar():
+    stakes = [0, 1, LAMPORTS_PER_SOL, 17 * LAMPORTS_PER_SOL,
+              4_194_304 * LAMPORTS_PER_SOL, U64_MAX]
+    arr = stake_buckets_array(np.array(stakes, dtype=np.uint64))
+    assert list(arr) == [get_stake_bucket(s) for s in stakes]
+
+
+def test_pubkey_new_unique_matches_reference_fixtures():
+    # Counter values 1..10 produce the exact base58 strings hardcoded in the
+    # reference stats tests (gossip_stats.rs:2024-2055).
+    got = [pubkey_new_unique().to_string() for _ in range(10)]
+    assert got[0] == "1111111QLbz7JHiBTspS962RLKV8GndWFwiEaqKM"
+    assert got[6] == "11111113pNDtm61yGF8j2ycAwLEPsuWQXobye5qDR"
+    assert got[9] == "111111152P2r5yt6odmBLPsFCLBrFisJ3aS7LqLAT"
+
+
+def test_base58_roundtrip():
+    for _ in range(5):
+        pk = pubkey_new_unique()
+        assert Pubkey.from_string(pk.to_string()) == pk
+    raw = bytes(range(32))
+    assert b58decode(b58encode(raw), 32) == raw
+
+
+def test_node_index_string_order():
+    accounts = {pubkey_new_unique(): (i + 1) * LAMPORTS_PER_SOL
+                for i in range(20)}
+    idx = NodeIndex.from_stakes(accounts)
+    strings = [pk.to_string() for pk in idx.pubkeys]
+    assert strings == sorted(strings)
+    # stakes follow the permutation
+    for i, pk in enumerate(idx.pubkeys):
+        assert idx.stakes[i] == accounts[pk]

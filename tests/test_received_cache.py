@@ -1,0 +1,87 @@
+"""Exact port of the reference's received-cache golden test
+(received_cache.rs:141-200)."""
+
+from gossip_sim_tpu.identity import pubkey_new_unique
+from gossip_sim_tpu.oracle.received_cache import ReceivedCache
+
+
+def test_received_cache():
+    cache = ReceivedCache(capacity=100)
+    pubkey = pubkey_new_unique()
+    origin = pubkey_new_unique()
+    records = [
+        [3, 1, 7, 5],
+        [7, 6, 5, 2],
+        [2, 0, 0, 2],
+        [3, 5, 0, 6],
+        [6, 2, 6, 2],
+    ]
+    nodes = [pubkey_new_unique() for _ in records]
+    for node, recs in zip(nodes, records):
+        for num_dups, k in enumerate(recs):
+            for _ in range(k):
+                cache.record(origin, node, num_dups)
+
+    entry = cache.cache[origin]
+    assert entry.num_upserts == 21
+    expected_scores = {nodes[0]: 4, nodes[1]: 13, nodes[2]: 2,
+                       nodes[3]: 8, nodes[4]: 8}
+    assert entry.nodes == expected_scores
+
+    stakes = {nodes[0]: 6, nodes[1]: 1, nodes[2]: 5, nodes[3]: 3,
+              nodes[4]: 7, pubkey: 9, origin: 9}
+
+    # First prune on a copy-equivalent: rebuild an identical cache.
+    cache2 = ReceivedCache(capacity=100)
+    for node, recs in zip(nodes, records):
+        for num_dups, k in enumerate(recs):
+            for _ in range(k):
+                cache2.record(origin, node, num_dups)
+    got = set(cache2.prune(pubkey, origin, 0.5, 2, stakes))
+    assert got == {nodes[0], nodes[2], nodes[3]}
+
+    got = set(cache.prune(pubkey, origin, 1.0, 0, stakes))
+    assert got == {nodes[0], nodes[2]}
+
+
+def test_prune_resets_entry_state():
+    # The gate consumes the entry (mem::take, received_cache.rs:55): after a
+    # successful prune, scores and upserts restart from zero.
+    cache = ReceivedCache(capacity=10)
+    pubkey = pubkey_new_unique()
+    origin = pubkey_new_unique()
+    peer = pubkey_new_unique()
+    stakes = {pubkey: 100, origin: 100, peer: 1}
+    for _ in range(20):
+        cache.record(origin, peer, 0)
+    assert cache.cache[origin].num_upserts == 20
+    cache.prune(pubkey, origin, 0.0, 0, stakes)
+    assert cache.cache[origin].num_upserts == 0
+    assert cache.cache[origin].nodes == {}
+
+
+def test_prune_gate_below_threshold():
+    cache = ReceivedCache(capacity=10)
+    pubkey = pubkey_new_unique()
+    origin = pubkey_new_unique()
+    peer = pubkey_new_unique()
+    stakes = {pubkey: 100, origin: 100, peer: 1}
+    for _ in range(19):
+        cache.record(origin, peer, 0)
+    assert cache.prune(pubkey, origin, 0.0, 0, stakes) == []
+    assert cache.cache[origin].num_upserts == 19  # untouched
+
+
+def test_capacity_gate_for_late_messages():
+    # num_dups >= 2 inserts only while under capacity 50
+    # (received_cache.rs:91-97); timely messages always insert.
+    cache = ReceivedCache(capacity=10)
+    origin = pubkey_new_unique()
+    late_peers = [pubkey_new_unique() for _ in range(60)]
+    for p in late_peers:
+        cache.record(origin, p, 5)
+    assert len(cache.cache[origin].nodes) == 50
+    timely = pubkey_new_unique()
+    cache.record(origin, timely, 1)
+    assert len(cache.cache[origin].nodes) == 51
+    assert cache.cache[origin].nodes[timely] == 1

@@ -1,0 +1,70 @@
+"""RNG-stack parity: the ChaCha/rand port reproduces the reference's streams.
+
+The golden values here are reference test assertions that depend directly on
+the ChaCha20 stream: stake buckets (gossip.rs:1082), and stake stats over
+seeded stakes (gossip_stats.rs:2032-2071).
+"""
+
+from gossip_sim_tpu.constants import LAMPORTS_PER_SOL
+from gossip_sim_tpu.identity import get_stake_bucket, pubkey_new_unique
+from gossip_sim_tpu.oracle.rustrng import ChaChaRng
+
+MAX_STAKE = (1 << 20) * LAMPORTS_PER_SOL
+
+
+def test_seeded_stake_buckets():
+    # gossip.rs:1078-1087: 6 draws from seed [189;32] bucket to
+    # [15, 16, 19, 19, 20, 20] when sorted by stake.
+    rng = ChaChaRng.from_seed_byte(189)
+    stakes = [rng.gen_range_u64(1, MAX_STAKE) for _ in range(6)]
+    assert [get_stake_bucket(s) for s in sorted(stakes)] == \
+        [15, 16, 19, 19, 20, 20]
+
+
+def test_seeded_stakes_match_stranded_goldens():
+    # gossip_stats.rs:2007-2042: stakes drawn for counter-pubkeys 1..10; the
+    # four stranded nodes' stakes have these exact stats.
+    nodes = [pubkey_new_unique() for _ in range(9)]
+    pk = pubkey_new_unique()
+    rng = ChaChaRng.from_seed_byte(189)
+    stakes = {n.to_string(): rng.gen_range_u64(1, MAX_STAKE) for n in nodes}
+    stakes[pk.to_string()] = rng.gen_range_u64(1, MAX_STAKE)
+    stranded = [
+        "11111113pNDtm61yGF8j2ycAwLEPsuWQXobye5qDR",
+        "11111114DhpssPJgSi1YU7hCMfYt1BJ334YgsffXm",
+        "11111114d3RrygbPdAtMuFnDmzsN8T5fYKVQ7FVr7",
+        "111111152P2r5yt6odmBLPsFCLBrFisJ3aS7LqLAT",
+    ]
+    vals = sorted(stakes[s] for s in stranded)
+    assert sum(vals) / 4 == 645017127080371.25
+    assert (vals[1] + vals[2]) / 2 == 724161057685112.0
+    assert vals[-1] == 1017190976849038
+    assert vals[0] == 114555416102223
+
+
+def test_f64_distribution_range():
+    rng = ChaChaRng.from_seed_byte(7)
+    vals = [rng.gen_f64() for _ in range(1000)]
+    assert all(0.0 <= v < 1.0 for v in vals)
+    assert 0.4 < sum(vals) / len(vals) < 0.6
+
+
+def test_gen_range_bounds():
+    rng = ChaChaRng.from_seed_byte(3)
+    for _ in range(1000):
+        v = rng.gen_range_u64(5, 17)
+        assert 5 <= v < 17
+
+
+def test_u64_straddles_buffer():
+    # 63 u32 draws leave one word in the buffer; next_u64 must straddle the
+    # refill exactly like rand_core's BlockRng.
+    rng = ChaChaRng.from_seed_byte(1)
+    first = [rng.next_u32() for _ in range(63)]
+    assert len(set(first)) > 32  # sanity: not constant
+    v = rng.next_u64()
+    rng2 = ChaChaRng.from_seed_byte(1)
+    buf1 = [rng2.next_u32() for _ in range(64)]
+    lo = buf1[63]
+    hi = rng2.next_u32()
+    assert v == (hi << 32) | lo
