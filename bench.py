@@ -1,0 +1,95 @@
+"""Benchmark: full five-verb gossip rounds, 10k-node cluster, batched origins.
+
+Prints ONE JSON line:
+  {"metric": "origin_iters_per_sec", "value": ..., "unit": "origin*iters/s",
+   "vs_baseline": ...}
+
+Baseline context (BASELINE.md): the north-star target is 10k nodes x ALL
+origins x 1000 iterations in < 60 s on a v5e-8 — i.e. 166,667 origin-iters/s
+across 8 chips, 20,833 per chip.  ``vs_baseline`` is measured single-chip
+throughput over that per-chip share (>= 1.0 means the 8-chip target is met
+by origin-parallel scaling, which is collective-free).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+PER_CHIP_TARGET = 166_667.0 / 8  # origin-iters/s
+
+
+def synthetic_stakes(n, seed=0):
+    """Heavy-tailed mainnet-like stake distribution (lognormal, ~5 orders of
+    magnitude spread like the real validator set)."""
+    rng = np.random.default_rng(seed)
+    sol = np.exp(rng.normal(9.5, 2.0, n)).astype(np.int64) + 1
+    return sol * 1_000_000_000
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-nodes", type=int, default=10_000)
+    ap.add_argument("--origin-batch", type=int, default=32)
+    ap.add_argument("--iterations", type=int, default=100)
+    ap.add_argument("--warmup-timing", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                       make_cluster_tables, run_rounds)
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":  # CI / no-accelerator fallback: keep it quick
+        args.num_nodes = min(args.num_nodes, 1000)
+        args.origin_batch = min(args.origin_batch, 4)
+        args.iterations = min(args.iterations, 20)
+
+    n, o = args.num_nodes, args.origin_batch
+    tables = make_cluster_tables(synthetic_stakes(n))
+    params = EngineParams(num_nodes=n, warm_up_rounds=0)
+    origins = jnp.arange(o, dtype=jnp.int32)
+
+    t0 = time.time()
+    state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+    jax.block_until_ready(state)
+    t_init = time.time() - t0
+
+    # compile + protocol warm-up (also brings the prune/rotate paths live)
+    state, rows = run_rounds(params, tables, origins, state,
+                             args.warmup_timing)
+    jax.block_until_ready(rows)
+
+    t0 = time.time()
+    state, rows = run_rounds(params, tables, origins, state, args.iterations,
+                             start_it=args.warmup_timing)
+    jax.block_until_ready(rows)
+    dt = time.time() - t0
+
+    value = o * args.iterations / dt
+    cov = float(np.asarray(rows["coverage"]).mean())
+    rmr = float(np.asarray(rows["rmr"]).mean())
+    result = {
+        "metric": "origin_iters_per_sec",
+        "value": round(value, 2),
+        "unit": "origin*iters/s",
+        "vs_baseline": round(value / PER_CHIP_TARGET, 4),
+        "platform": platform,
+        "num_nodes": n,
+        "origin_batch": o,
+        "iterations": args.iterations,
+        "elapsed_s": round(dt, 3),
+        "init_s": round(t_init, 3),
+        "coverage_mean": round(cov, 6),
+        "rmr_mean": round(rmr, 6),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+"""TPU engine: the five-verb gossip round as jitted dense-array kernels.
+
+This is the TPU-native backend.  State lives in dense arrays indexed by node
+id ``i in [0, N)`` (pubkeys exist only at the I/O edge, see ``identity``);
+one ``SimState`` batches ``O`` independent single-origin simulations (the
+reference runs one origin per simulation, gossip_main.rs:292-647 — the origin
+axis is therefore embarrassingly parallel and is this framework's main
+scaling axis, vmapped on one chip and sharded over the device mesh).
+
+64-bit types are enabled here because lamport stakes exceed 2**53 and the
+prune stake-threshold arithmetic (received_cache.rs:112-115) must match the
+reference's u64/f64 semantics.  Import this package before running other JAX
+code so the flag takes effect globally.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .params import EngineParams  # noqa: E402
+from .sampler import SamplerTables, build_sampler_tables  # noqa: E402
+from .core import (  # noqa: E402
+    ClusterTables,
+    SimState,
+    init_state,
+    make_cluster_tables,
+    round_step,
+    run_rounds,
+)
+
+__all__ = [
+    "EngineParams",
+    "SamplerTables",
+    "build_sampler_tables",
+    "ClusterTables",
+    "SimState",
+    "init_state",
+    "make_cluster_tables",
+    "round_step",
+    "run_rounds",
+]

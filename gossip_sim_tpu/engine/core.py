@@ -1,0 +1,523 @@
+"""The five-verb gossip round as batched dense-array kernels.
+
+One ``SimState`` holds ``O`` independent single-origin simulations over an
+``N``-node cluster (the reference simulates exactly one origin per run,
+gossip_main.rs:292-647; batching origins is the north-star parallelization,
+SURVEY.md §2.3).  Per round, matching gossip_main.rs:449-473:
+
+  1. push/diffuse  — fanout-target selection + frontier relaxation
+                     (replaces the sequential BFS, gossip.rs:494-615)
+  2. consume       — rank inbound peers by (hop, node index) and merge into
+                     the received cache (gossip.rs:618-653,
+                     received_cache.rs:83-98)
+  3. prune decide  — upsert-gated (score, stake) ranking + stake-threshold
+                     prefix rule (received_cache.rs:38-63,100-131)
+  4. prune apply   — set per-slot pruned bits in the senders' active entries
+                     (push_active_set.rs:56-71,143-151)
+  5. rotate        — Bernoulli(p) incremental rotation: swap one weighted
+                     sample in, evict the oldest slot (gossip.rs:739-754,
+                     push_active_set.rs:153-186)
+
+Key origin-reduction insight: stakes are static, so for a fixed origin ``o``
+every node ``s`` reads/writes exactly ONE active-set entry — bucket
+``min(bucket(s), bucket(o))`` (push_active_set.rs:48,68; bucketing is
+monotone in stake, so bucket(min) == min(bucket)).  Each of the O sims
+therefore tracks a single [N, S] active-set slice instead of [N, 25, S],
+and the 25-bucket structure survives only in the rotation weights.
+
+Documented divergences from the reference (all distribution-level, none
+affecting the semantics downstream of sampling):
+
+  * WeightedShuffle -> stake-class categorical sampling (see sampler.py);
+    parity is distributional (selection probability ∝ weight).
+  * The per-peer pruned-origin Bloom filter (0.1 false-positive rate,
+    push_active_set.rs:122-123) is an exact per-slot bit: the engine never
+    over-prunes from bloom false positives.  The self-seeded entry
+    (push_active_set.rs:179) is the exact ``peer != origin`` mask.
+  * Inbound peers per (dest, round) are ranked exactly but only the first
+    ``inbound_cap`` ranks are recorded (reference records all); ranks >= 2
+    only reserve score-0 slots, so the tail is statistics-neutral in
+    realistic regimes.  Dropped edges are counted in ``rows["inb_dropped"]``.
+  * The received-cache entry is ``rc_slots`` physical slots; the reference's
+    50-entry *insert cap* (received_cache.rs:78) is enforced exactly, but a
+    pathological mix of unconditional scored inserts could exceed the
+    physical slots; overflow evicts the largest node ids and is counted in
+    ``rows["rc_overflow"]``.
+  * On exact (score, stake) prune ties the reference's unstable sort is
+    nondeterministic; the engine tie-breaks by node index ascending (the
+    CPU oracle tie-breaks by pubkey bytes — craft distinct stakes in parity
+    tests).
+  * Per-thread entropy RNG (gossip.rs:747-753) is replaced by
+    ``fold_in(key, origin)``/``fold_in(key, round)`` counter-based streams:
+    deterministic by construction and independent of origin-batch chunking.
+  * Initialization samples active-set peers with replacement and keeps the
+    first S distinct (``init_draws`` tries); under extreme stake skew an
+    entry can start underfilled where the reference's WeightedShuffle always
+    fills to size.  Underfilled slots hold the sentinel ``N`` (never pushed
+    to) and are topped up by rotation events over time; callers can audit
+    fill via ``(state.active == N).sum()``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..identity import stake_buckets_array
+from .params import EngineParams
+from .sampler import SamplerTables, build_sampler_tables, sample_peers
+
+INF = jnp.int32(1 << 20)  # unreached sentinel (maps to u64::MAX, gossip.rs:490)
+
+
+class ClusterTables(NamedTuple):
+    """Static per-cluster device tables."""
+
+    stakes: jax.Array    # [N + 1] i64 lamports; index N is a 0 pad (sentinel)
+    buckets: jax.Array   # [N] i32 log2 stake buckets (push_active_set.rs:190-196)
+    sampler: SamplerTables
+
+
+class SimState(NamedTuple):
+    """O batched independent single-origin simulations (the carried pytree)."""
+
+    key: jax.Array          # [O, 2] u32 per-origin PRNG key
+    active: jax.Array       # [O, N, S] i32 peer per slot, oldest->newest; N = empty
+    pruned: jax.Array       # [O, N, S] bool peer-has-pruned-this-origin bit
+    rc_src: jax.Array       # [O, N, C] i32 received-cache peers, sorted asc; N = empty
+    rc_score: jax.Array     # [O, N, C] i32 per-peer scores (received_cache.rs:83-98)
+    rc_upserts: jax.Array   # [O, N] i32 upsert counter (received_cache.rs:13-21)
+    failed: jax.Array       # [O, N] bool fault-injection mask (gossip.rs:756-771)
+    egress_acc: jax.Array   # [O, N] i32 measured-round egress message counts
+    ingress_acc: jax.Array  # [O, N] i32 measured-round ingress message counts
+    prune_acc: jax.Array    # [O, N] i32 measured-round prune messages sent
+    stranded_acc: jax.Array  # [O, N] i32 measured rounds each node was stranded
+    hops_hist_acc: jax.Array  # [O, H] i32 aggregate hop histogram (measured)
+
+
+def make_cluster_tables(stakes_lamports: np.ndarray) -> ClusterTables:
+    """Build static device tables from the per-node stake vector."""
+    stakes = np.asarray(stakes_lamports, dtype=np.int64)
+    buckets = stake_buckets_array(stakes.astype(np.uint64)).astype(np.int32)
+    return ClusterTables(
+        stakes=jnp.asarray(np.concatenate([stakes, [0]])),
+        buckets=jnp.asarray(buckets),
+        sampler=build_sampler_tables(buckets),
+    )
+
+
+# --------------------------------------------------------------------------
+# small vector utilities
+# --------------------------------------------------------------------------
+
+def _row_searchsorted(sorted_rows: jax.Array, queries: jax.Array) -> jax.Array:
+    """Left-bisect each query into its row of ``sorted_rows``.
+
+    sorted_rows [..., C] ascending; queries [..., K] -> positions [..., K].
+    Fixed-trip binary search (log2(C) gathers) — avoids the O(K*C)
+    broadcast-compare blowup at production shapes.
+    """
+    C = sorted_rows.shape[-1]
+    lo = jnp.zeros(queries.shape, jnp.int32)
+    hi = jnp.full(queries.shape, C, jnp.int32)
+    for _ in range(max(1, math.ceil(math.log2(C))) + 1):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        vals = jnp.take_along_axis(sorted_rows, jnp.minimum(mid, C - 1), axis=-1)
+        less = vals < queries
+        lo = jnp.where(active & less, mid + 1, lo)
+        hi = jnp.where(active & ~less, mid, hi)
+    return lo
+
+
+def _gather_rows(mat: jax.Array, t_idx: jax.Array, pos: jax.Array) -> jax.Array:
+    """mat [O, N, C]; t_idx/pos [O, ...] -> mat[o, t_idx, pos] elementwise."""
+    O = mat.shape[0]
+    o_idx = jnp.arange(O).reshape((O,) + (1,) * (t_idx.ndim - 1))
+    return mat[o_idx, t_idx, pos]
+
+
+# --------------------------------------------------------------------------
+# initialization
+# --------------------------------------------------------------------------
+
+def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
+               params: EngineParams) -> SimState:
+    """Build O fresh single-origin sims with rotated-in active sets.
+
+    Initialization mirrors ``initialize_gossip`` (gossip_main.rs:263-277 ->
+    gossip.rs:805-813): every node's tracked entry is rotated from empty.
+    Rotating an empty entry inserts weighted-distinct peers until the entry
+    *exceeds* ``size`` and then evicts the oldest (push_active_set.rs:165-185)
+    — i.e. the kept set is distinct samples #2..S+1 when more than S are
+    available, else all of them.
+    """
+    p = params.validate()
+    N, S, E = p.num_nodes, p.active_set_size, p.init_draws
+    O = int(origins.shape[0])
+    origins = origins.astype(jnp.int32)
+
+    okeys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, origins)
+    # Domain-separate the init stream from the per-round streams (both fold
+    # small integers into the same per-origin key otherwise).
+    draw_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
+        okeys, 0x696E6974)
+    b = tables.buckets
+    k_os = jnp.minimum(b[None, :], b[origins][:, None])          # [O, N]
+    self_idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+
+    def draw_step(carry, e):
+        buf, cnt = carry                                         # [O,N,S+1], [O,N]
+        ek = jax.vmap(jax.random.fold_in, in_axes=(0, None))(draw_keys, e)
+        u = jax.vmap(lambda k: jax.random.uniform(k, (N, 2), dtype=jnp.float32))(ek)
+        cand = sample_peers(tables.sampler, k_os, u[..., 0], u[..., 1])
+        dup = jnp.any(buf == cand[..., None], axis=-1) | (cand == self_idx)
+        ins = (~dup) & (cnt <= S)
+        slot = jnp.minimum(cnt, S)
+        oh = (jnp.arange(S + 1)[None, None, :] == slot[..., None]) & ins[..., None]
+        buf = jnp.where(oh, cand[..., None], buf)
+        return (buf, cnt + ins.astype(jnp.int32)), None
+
+    buf0 = jnp.full((O, N, S + 1), N, dtype=jnp.int32)
+    (buf, cnt), _ = lax.scan(draw_step, (buf0, jnp.zeros((O, N), jnp.int32)),
+                             jnp.arange(E))
+    # Evict the oldest iff the entry overfilled (push_active_set.rs:182-185).
+    active = jnp.where((cnt > S)[..., None], buf[..., 1:], buf[..., :S])
+
+    C, H = p.rc_slots, p.hist_bins
+    zi = lambda shape: jnp.zeros(shape, jnp.int32)
+    return SimState(
+        key=okeys,
+        active=active,
+        pruned=jnp.zeros((O, N, S), bool),
+        rc_src=jnp.full((O, N, C), N, jnp.int32),
+        rc_score=zi((O, N, C)),
+        rc_upserts=zi((O, N)),
+        failed=jnp.zeros((O, N), bool),
+        egress_acc=zi((O, N)),
+        ingress_acc=zi((O, N)),
+        prune_acc=zi((O, N)),
+        stranded_acc=zi((O, N)),
+        hops_hist_acc=zi((O, H)),
+    )
+
+
+# --------------------------------------------------------------------------
+# the round
+# --------------------------------------------------------------------------
+
+def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
+               state: SimState, it: jax.Array, detail: bool = False):
+    """One full gossip round for all O origin-sims.  Returns (state, rows).
+
+    ``rows`` is a dict of [O]-shaped per-round statistics; with
+    ``detail=True`` it additionally carries the [O, N] stranded mask (for
+    the per-iteration stranded-stake stats, gossip_stats.rs:766-843).
+    """
+    p = params
+    N, S, F, C, K, H = (p.num_nodes, p.active_set_size, p.push_fanout,
+                        p.rc_slots, p.inbound_cap, p.hist_bins)
+    O = int(origins.shape[0])
+    origins = origins.astype(jnp.int32)
+    o1 = jnp.arange(O)
+    o2 = o1[:, None]
+    o3 = o1[:, None, None]
+    n_idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+
+    kr = jax.vmap(jax.random.fold_in, in_axes=(0, None))(state.key, it)
+    nsub = p.rot_tries + 2
+    subs = jax.vmap(lambda k: jax.random.split(k, nsub))(kr)     # [O, nsub, 2]
+
+    # ---- fault injection (gossip.rs:756-771; fires when it == when_to_fail,
+    # gossip_main.rs:449-452) --------------------------------------------
+    failed = state.failed
+    # truncating, like the reference's `as usize` (gossip.rs:758)
+    n_fail = int(p.fail_fraction * N)
+    if p.fail_at >= 0 and n_fail > 0:
+        def _fail(f):
+            r = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
+                subs[:, 0])
+            kth = jnp.sort(r, axis=-1)[:, n_fail - 1][:, None]
+            return f | (r <= kth)
+        failed = lax.cond(it == p.fail_at, _fail, lambda f: f, failed)
+
+    # ---- verb 1: push/diffuse (gossip.rs:494-615) -----------------------
+    peer = state.active
+    origin_col = origins[:, None, None]
+    is_peer = peer < N
+    # get_nodes filter: bloom-contains(origin) == pruned bit OR peer == origin
+    # (self-seeded bloom, push_active_set.rs:128-141,179).
+    valid = is_peer & (~state.pruned) & (peer != origin_col)
+    sel = valid & (jnp.cumsum(valid, axis=-1) <= F)   # first F unpruned slots
+    peer_c = jnp.minimum(peer, N - 1)
+    peer_failed = failed[o3, peer_c] & is_peer
+    # Failed targets consume a fanout slot but receive nothing (gossip.rs:538-541).
+    tgt = jnp.where(sel & ~peer_failed, peer, N)                 # [O, N, S]
+
+    dist0 = jnp.full((O, N), INF, jnp.int32).at[o1, origins].set(0)
+
+    def relax(carry):
+        dist, _ = carry
+        cand = jnp.where(dist < INF, dist + 1, INF)[:, :, None]
+        cand = jnp.broadcast_to(cand, tgt.shape)
+        new = dist.at[o3, tgt].min(cand, mode="drop")
+        return new, jnp.any(new != dist)
+
+    dist, _ = lax.while_loop(lambda c: c[1], relax,
+                             (dist0, jnp.bool_(True)))
+    reached = dist < INF
+
+    live = (tgt < N) & reached[:, :, None]
+    edge_tgt = jnp.where(live, tgt, N)
+    deg_out = jnp.sum(live, axis=-1, dtype=jnp.int32)            # [O, N]
+    n_reached = jnp.sum(reached, axis=-1, dtype=jnp.int32)       # [O]
+    m_push = jnp.sum(deg_out, axis=-1, dtype=jnp.int32)          # [O]
+
+    egress_round = deg_out
+    ingress_round = jnp.zeros((O, N), jnp.int32).at[o3, edge_tgt].add(
+        1, mode="drop")
+
+    # ---- verb 2: consume (gossip.rs:618-653) ----------------------------
+    # Rank inbound edges per dest by (hop, src index) — index order equals
+    # the reference's pubkey-string sort by NodeIndex construction
+    # (gossip.rs:638-645; identity.NodeIndex).
+    hop1 = jnp.minimum(dist + 1, H - 1)
+    key1 = edge_tgt.reshape(O, N * S)
+    key2 = (hop1[:, :, None] * N + n_idx[:, :, None]).astype(jnp.int32)
+    key2 = jnp.broadcast_to(key2, (O, N, S)).reshape(O, N * S)
+    tgt_s, key2_s = lax.sort((key1, key2), dimension=-1, num_keys=2)
+    src_s = key2_s % N
+    eidx = jnp.arange(N * S, dtype=jnp.int32)[None, :]
+    is_start = jnp.concatenate(
+        [jnp.ones((O, 1), bool), tgt_s[:, 1:] != tgt_s[:, :-1]], axis=1)
+    seg_start = lax.cummax(jnp.where(is_start, eidx, 0), axis=1)
+    rank = eidx - seg_start
+    inb = jnp.full((O, N, K), N, jnp.int32).at[
+        o2, tgt_s, rank].set(src_s, mode="drop")
+    inb_dropped = jnp.sum((rank >= K) & (tgt_s < N), axis=-1, dtype=jnp.int32)
+
+    # merge inbound into the received cache (received_cache.rs:83-98)
+    rc_src, rc_score = state.rc_src, state.rc_score
+    pos = _row_searchsorted(rc_src, inb)                         # [O, N, K]
+    pos_c = jnp.minimum(pos, C - 1)
+    found = (inb < N) & (pos < C) & (
+        jnp.take_along_axis(rc_src, pos_c, axis=-1) == inb)
+    for r in (0, 1):  # num_dups < NUM_DUPS_THRESHOLD -> score += 1
+        oh = (jnp.arange(C)[None, None, :] == pos_c[..., r:r + 1])
+        rc_score = rc_score + (oh & found[..., r:r + 1]).astype(jnp.int32)
+
+    base_len = jnp.sum(rc_src < N, axis=-1, dtype=jnp.int32)
+
+    def ins_step(ln, x):
+        found_r, inb_r, r = x
+        want = (inb_r < N) & ~found_r
+        # scored ranks insert unconditionally; others honor the 50-entry cap
+        # (received_cache.rs:92-97)
+        allowed = want & ((r < 2) | (ln < p.received_cap))
+        return ln + allowed.astype(jnp.int32), allowed
+
+    _, allowed_t = lax.scan(
+        ins_step, base_len,
+        (jnp.moveaxis(found, -1, 0), jnp.moveaxis(inb, -1, 0),
+         jnp.arange(K)))
+    allowed = jnp.moveaxis(allowed_t, 0, -1)                     # [O, N, K]
+    acc_src = jnp.where(allowed, inb, N)
+    acc_score = (allowed & (jnp.arange(K)[None, None, :] < 2)).astype(jnp.int32)
+    acc_src, acc_score = lax.sort((acc_src, acc_score), dimension=-1, num_keys=1)
+
+    # merge two sorted-by-src lists via rank addition (no full re-sort)
+    n3 = jnp.arange(N)[None, :, None]
+    merged_src = jnp.full((O, N, C + K), N, jnp.int32)
+    merged_score = jnp.zeros((O, N, C + K), jnp.int32)
+    p_old = jnp.arange(C, dtype=jnp.int32) + _row_searchsorted(acc_src, rc_src)
+    p_old = jnp.where(rc_src < N, p_old, C + K)  # sentinels -> dropped
+    merged_src = merged_src.at[o3, n3, p_old].set(rc_src, mode="drop")
+    merged_score = merged_score.at[o3, n3, p_old].set(rc_score, mode="drop")
+    p_new = jnp.arange(K, dtype=jnp.int32) + _row_searchsorted(rc_src, acc_src)
+    p_new = jnp.where(acc_src < N, p_new, C + K)
+    merged_src = merged_src.at[o3, n3, p_new].set(acc_src, mode="drop")
+    merged_score = merged_score.at[o3, n3, p_new].set(acc_score, mode="drop")
+    rc_overflow = jnp.sum(merged_src[..., C:] < N, axis=(-2, -1),
+                          dtype=jnp.int32)
+    rc_src = merged_src[..., :C]
+    rc_score = merged_score[..., :C]
+
+    any_inb = inb[..., 0] < N  # a rank-0 record is one upsert (received_cache.rs:85-87)
+    rc_ups = state.rc_upserts + any_inb.astype(jnp.int32)
+
+    # ---- verb 3: prune decide (received_cache.rs:38-63,100-131) ---------
+    fired = rc_ups >= p.min_num_upserts
+    stake_dest = tables.stakes[:N][None, :]                      # [1, N] i64
+    stake_org = tables.stakes[origins][:, None]                  # [O, 1]
+    min_stake = jnp.minimum(stake_dest, stake_org)
+    # f64 multiply then u64 truncation, as the reference does
+    # (received_cache.rs:112-115).
+    min_ingress_stake = (min_stake.astype(jnp.float64)
+                         * p.prune_stake_threshold).astype(jnp.int64)
+
+    member = rc_src < N
+    m_stake = tables.stakes[rc_src]                              # pad -> 0
+    neg_score = jnp.where(member, -rc_score, jnp.iinfo(jnp.int32).max)
+    neg_stake = jnp.where(member, -m_stake, jnp.iinfo(jnp.int64).max)
+    _, _, src_sorted = lax.sort(
+        (neg_score, neg_stake, rc_src), dimension=-1, num_keys=3)
+    memb_sorted = src_sorted < N
+    stake_sorted = tables.stakes[src_sorted]
+    cum_excl = jnp.cumsum(stake_sorted, axis=-1) - stake_sorted
+    posn = jnp.arange(C)[None, None, :]
+    pruned_slot = (memb_sorted
+                   & (posn >= p.min_ingress_nodes)
+                   & (cum_excl >= min_ingress_stake[..., None])
+                   & (src_sorted != origin_col)
+                   & fired[..., None])
+    n_pruned = jnp.sum(pruned_slot, axis=-1, dtype=jnp.int32)    # [O, N] per pruner
+    m_prunes = jnp.sum(n_pruned, axis=-1, dtype=jnp.int32)       # [O]
+    # Prune messages count toward RMR's m (gossip.rs:684-687).
+
+    # ---- verb 4: prune apply (push_active_set.rs:56-71,143-151) ---------
+    pr_sorted = lax.sort(jnp.where(pruned_slot, src_sorted, N), dimension=-1)
+    t_c = peer_c  # current active peers; prune touches existing entries only
+    q = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[None, :, None],
+                         (O, N, S))
+    lo = jnp.zeros((O, N, S), jnp.int32)
+    hi = jnp.full((O, N, S), C, jnp.int32)
+    for _ in range(max(1, math.ceil(math.log2(C))) + 1):
+        act = lo < hi
+        mid = (lo + hi) // 2
+        vals = _gather_rows(pr_sorted, t_c, jnp.minimum(mid, C - 1))
+        less = vals < q
+        lo = jnp.where(act & less, mid + 1, lo)
+        hi = jnp.where(act & ~less, mid, hi)
+    hit = (lo < C) & (_gather_rows(pr_sorted, t_c, jnp.minimum(lo, C - 1)) == q)
+    pruned_bits = state.pruned | (hit & is_peer)
+
+    # mem::take on fire: the whole entry resets (received_cache.rs:48-55)
+    rc_src = jnp.where(fired[..., None], N, rc_src)
+    rc_score = jnp.where(fired[..., None], 0, rc_score)
+    rc_ups = jnp.where(fired, 0, rc_ups)
+
+    # ---- verb 5: rotate (gossip.rs:739-754; push_active_set.rs:153-186) -
+    b = tables.buckets
+    k_os = jnp.minimum(b[None, :], b[origins][:, None])
+    rot_u = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
+        subs[:, 1])
+    rotate = rot_u < p.probability_of_rotation
+    chosen = jnp.full((O, N), N, jnp.int32)
+    found_new = jnp.zeros((O, N), bool)
+    self_i = jnp.arange(N, dtype=jnp.int32)[None, :]
+    active_now = peer
+    for t in range(p.rot_tries):
+        u = jax.vmap(lambda k: jax.random.uniform(k, (N, 2), dtype=jnp.float32))(
+            subs[:, 2 + t])
+        cand = sample_peers(tables.sampler, k_os, u[..., 0], u[..., 1])
+        ok = ((cand != self_i)
+              & ~jnp.any(active_now == cand[..., None], axis=-1))
+        take = ok & ~found_new
+        chosen = jnp.where(take, cand, chosen)
+        found_new = found_new | ok
+    do_rot = rotate & found_new
+    rot_failed = jnp.sum(rotate & ~found_new, axis=-1, dtype=jnp.int32)
+
+    mcnt = jnp.sum(active_now < N, axis=-1, dtype=jnp.int32)
+    full_row = mcnt >= S
+    shift_act = jnp.concatenate([active_now[..., 1:], chosen[..., None]], axis=-1)
+    shift_prn = jnp.concatenate(
+        [pruned_bits[..., 1:], jnp.zeros((O, N, 1), bool)], axis=-1)
+    slot_oh = (jnp.arange(S)[None, None, :] == jnp.minimum(mcnt, S - 1)[..., None])
+    append_act = jnp.where(slot_oh & ~full_row[..., None],
+                           chosen[..., None], active_now)
+    new_active = jnp.where(do_rot[..., None],
+                           jnp.where(full_row[..., None], shift_act, append_act),
+                           active_now)
+    new_pruned = jnp.where((do_rot & full_row)[..., None], shift_prn, pruned_bits)
+
+    # ---- statistics (gossip_stats.rs; on-device reductions) -------------
+    hr = jnp.zeros((O, H), jnp.int32).at[
+        o2, jnp.minimum(dist, H - 1)].add(reached.astype(jnp.int32))
+    pos_counts = hr.at[:, 0].set(0)          # HopsStat filters origin's 0 hops
+    cnt = jnp.sum(pos_counts, axis=-1)
+    hsum = jnp.sum(pos_counts * jnp.arange(H)[None, :], axis=-1)
+    hop_mean = jnp.where(cnt > 0, hsum / jnp.maximum(cnt, 1), jnp.nan)
+    csum = jnp.cumsum(pos_counts[:, 1:], axis=-1)                # [O, H-1]
+    lo_i = (cnt - 1) // 2
+    hi_i = cnt // 2
+    val_of = lambda i: 1 + jnp.sum((csum <= i[:, None]).astype(jnp.int32), axis=-1)
+    hop_median = jnp.where(cnt > 0, (val_of(lo_i) + val_of(hi_i)) / 2.0, 0.0)
+    pos_hops = jnp.where(reached & (dist > 0), dist, 0)
+    hop_max = jnp.max(pos_hops, axis=-1)
+    hop_min = jnp.where(
+        cnt > 0,
+        jnp.min(jnp.where(reached & (dist > 0), dist, INF), axis=-1), 0)
+
+    stranded = (~reached) & (~failed)
+    stranded_cnt = jnp.sum(stranded, axis=-1, dtype=jnp.int32)
+    m_total = m_push + m_prunes
+    nn = n_reached
+    rmr = jnp.where(nn > 1, m_total / jnp.maximum(nn - 1, 1) - 1.0, 0.0)
+    branching = m_push / jnp.maximum(nn, 1)   # Σ|pushes[src]| / |pushes|
+
+    measured = it >= p.warm_up_rounds
+    g = measured.astype(jnp.int32)
+    new_state = SimState(
+        key=state.key,
+        active=new_active,
+        pruned=new_pruned,
+        rc_src=rc_src,
+        rc_score=rc_score,
+        rc_upserts=rc_ups,
+        failed=failed,
+        egress_acc=state.egress_acc + g * egress_round,
+        ingress_acc=state.ingress_acc + g * ingress_round,
+        prune_acc=state.prune_acc + g * n_pruned,
+        stranded_acc=state.stranded_acc + g * stranded.astype(jnp.int32),
+        hops_hist_acc=state.hops_hist_acc + g * hr,
+    )
+    rows = {
+        "coverage": (n_reached / N).astype(jnp.float32),
+        "unvisited": (N - n_reached).astype(jnp.int32),
+        "m": m_total,
+        "n": nn,
+        "rmr": rmr.astype(jnp.float32),
+        "hop_mean": hop_mean.astype(jnp.float32),
+        "hop_median": hop_median.astype(jnp.float32),
+        "hop_max": hop_max.astype(jnp.int32),
+        "hop_min": hop_min.astype(jnp.int32),
+        "stranded": stranded_cnt,
+        "branching": branching.astype(jnp.float32),
+        "prunes_sent": m_prunes,
+        "inb_dropped": inb_dropped,
+        "rc_overflow": rc_overflow,
+        "rot_failed": rot_failed,
+    }
+    if detail:
+        rows["stranded_mask"] = stranded
+        rows["dist"] = jnp.where(reached, dist, -1).astype(jnp.int32)
+    return new_state, rows
+
+
+# --------------------------------------------------------------------------
+# multi-round runner
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(0, 4, 5), donate_argnums=(3,))
+def _run(params, tables, origins, state, num_iters, detail, start_it):
+    def step(st, it):
+        return round_step(params, tables, origins, st, it, detail=detail)
+    its = jnp.arange(num_iters) + start_it
+    return lax.scan(step, state, its)
+
+
+def run_rounds(params: EngineParams, tables: ClusterTables, origins: jax.Array,
+               state: SimState, num_iters: int, start_it=0,
+               detail: bool = False):
+    """Run ``num_iters`` rounds under one jitted scan (the reference's hot
+    loop, gossip_main.rs:425-565).  Returns (state, rows-of-arrays with a
+    leading [num_iters] axis)."""
+    return _run(params, tables, origins, state, int(num_iters), bool(detail),
+                jnp.asarray(start_it, jnp.int32))

@@ -1,0 +1,53 @@
+"""Static engine parameters (hashable; baked into each compiled round step).
+
+Mirrors the reference's flat ``Config`` (gossip.rs:111-133) plus the dense
+shapes the TPU formulation introduces.  Sweeps (gossip_main.rs:774-951) step
+one field per simulation; each distinct value compiles once and is cached.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+from ..constants import (MIN_NUM_UPSERTS, NUM_PUSH_ACTIVE_SET_ENTRIES,
+                         RECEIVED_CACHE_CAPACITY)
+
+
+class EngineParams(NamedTuple):
+    """Static (compile-time) simulation parameters."""
+
+    num_nodes: int
+    push_fanout: int = 6                 # gossip_main.rs:90
+    active_set_size: int = 12            # gossip_main.rs:97
+    probability_of_rotation: float = 0.013333  # gossip_main.rs:124 (1/75)
+    prune_stake_threshold: float = 0.15  # gossip_main.rs:142
+    min_ingress_nodes: int = 2           # gossip_main.rs:135
+    warm_up_rounds: int = 200            # gossip_main.rs:223
+    fail_at: int = -1                    # --when-to-fail; -1 = never
+    fail_fraction: float = 0.0           # --fraction-to-fail
+
+    min_num_upserts: int = MIN_NUM_UPSERTS          # received_cache.rs:21
+    received_cap: int = RECEIVED_CACHE_CAPACITY     # received_cache.rs:78
+
+    # Dense-shape knobs (TPU formulation only; see engine/core.py for the
+    # documented divergences they introduce):
+    rc_slots: int = 64      # physical received-cache slots per (origin, node)
+    inbound_cap: int = 16   # inbound peers ranked per (origin, dest, round)
+    hist_bins: int = 64     # on-device hop-histogram bins
+    rot_tries: int = 8      # rejection-sampling tries per rotation event
+    init_draws: int = 64    # candidate draws per entry at initialization
+
+    @property
+    def num_buckets(self) -> int:
+        return NUM_PUSH_ACTIVE_SET_ENTRIES
+
+    def validate(self) -> "EngineParams":
+        assert self.num_nodes >= 2
+        # Enough physical slots for the reference's insert cap (or for every
+        # possible peer, whichever is smaller) so the 50-entry cap semantics
+        # (received_cache.rs:78) hold without overflow eviction.
+        assert self.rc_slots >= min(self.received_cap, self.num_nodes - 1), (
+            "rc_slots too small for the received-cache insert cap")
+        assert self.inbound_cap >= 2, "need at least the two scored ranks"
+        assert self.init_draws > self.active_set_size
+        return self

@@ -1,0 +1,92 @@
+"""Stake-class weighted peer sampling — the TPU replacement for WeightedShuffle.
+
+The reference drives active-set selection with
+``solana_gossip::weighted_shuffle::WeightedShuffle`` (push_active_set.rs:164):
+a stake-weight-proportional permutation consumed lazily until the entry is
+full.  Its per-candidate weight for entry ``k`` is ``(min(bucket_j, k) + 1)^2``
+(push_active_set.rs:96-111) — it depends on the candidate *only through its
+stake bucket*.  With 25 buckets there are only 25 distinct weight values per
+entry, so sampling factorizes exactly:
+
+  1. draw the *bucket class* from a 25-way categorical with mass
+     ``count[c] * (min(c, k) + 1)^2``  (a 25-entry CDF per ``k``, precomputed
+     once per cluster — stakes are static);
+  2. draw a node uniformly *within* the class (equal weights inside a class);
+  3. map through the bucket-sorted permutation back to the node id.
+
+One draw costs a 25-way compare + two gathers instead of an O(N) weighted
+shuffle — and the distribution is exactly selection-probability ∝ weight,
+which is the parity contract (SURVEY.md §7: statistical parity at the
+sampling boundary, exact parity downstream).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import NUM_PUSH_ACTIVE_SET_ENTRIES
+
+NB = NUM_PUSH_ACTIVE_SET_ENTRIES  # 25
+
+
+class SamplerTables(NamedTuple):
+    """Static per-cluster sampling tables (all device arrays)."""
+
+    perm: jax.Array          # [N] i32  node ids sorted by bucket (stable)
+    class_start: jax.Array   # [NB] i32 offset of each bucket class in perm
+    class_count: jax.Array   # [NB] i32 nodes per bucket class
+    class_cdf: jax.Array     # [NB, NB] f32 normalized inclusive CDF per entry k
+
+
+def build_sampler_tables(buckets: np.ndarray) -> SamplerTables:
+    """Precompute the class tables from per-node stake buckets (static)."""
+    buckets = np.asarray(buckets, dtype=np.int32)
+    n = buckets.shape[0]
+    perm = np.argsort(buckets, kind="stable").astype(np.int32)
+    class_count = np.bincount(buckets, minlength=NB).astype(np.int32)
+    class_start = np.concatenate([[0], np.cumsum(class_count)[:-1]]).astype(np.int32)
+
+    # mass[k, c] = count[c] * (min(c, k) + 1)^2   (push_active_set.rs:96-111)
+    c = np.arange(NB)
+    weight = (np.minimum(c[None, :], np.arange(NB)[:, None]) + 1) ** 2
+    mass = class_count[None, :].astype(np.float64) * weight
+    cdf = np.cumsum(mass, axis=1)
+    totals = cdf[:, -1:]
+    totals = np.where(totals == 0, 1.0, totals)
+    cdf = (cdf / totals).astype(np.float32)
+    cdf[:, -1] = 1.0
+
+    return SamplerTables(
+        perm=jnp.asarray(perm),
+        class_start=jnp.asarray(class_start),
+        class_count=jnp.asarray(class_count),
+        class_cdf=jnp.asarray(cdf),
+    )
+
+
+def sample_peers(tables: SamplerTables, k_entry: jax.Array,
+                 u_class: jax.Array, u_member: jax.Array) -> jax.Array:
+    """Draw one weighted peer per element.
+
+    k_entry:  [...] i32 — the active-set entry index (0..24) whose weight
+              profile to use; for origin-reduced state this is
+              ``min(bucket(node), bucket(origin))`` (push_active_set.rs:48).
+    u_class:  [...] f32 uniforms in [0, 1) — class draw.
+    u_member: [...] f32 uniforms in [0, 1) — within-class draw.
+
+    Returns node ids with P(node j) ∝ (min(bucket_j, k) + 1)^2, sampled
+    *with* replacement; callers do rejection/dedup for without-replacement
+    semantics (push_active_set.rs:165-177 skips already-present peers).
+    """
+    cdf_rows = tables.class_cdf[k_entry]                  # [..., NB]
+    cls = jnp.sum((u_class[..., None] >= cdf_rows[..., :-1]).astype(jnp.int32),
+                  axis=-1)                                # [...] in [0, NB)
+    count = tables.class_count[cls]
+    member = tables.class_start[cls] + jnp.floor(
+        u_member * count.astype(jnp.float32)).astype(jnp.int32)
+    member = jnp.minimum(member, tables.class_start[cls] + jnp.maximum(count - 1, 0))
+    return tables.perm[member]

@@ -1,0 +1,13 @@
+"""Device-mesh construction and state sharding.
+
+The origin axis is this framework's data-parallel axis (each origin is an
+independent simulation, gossip_main.rs:292-647 — no cross-origin traffic, so
+origin sharding rides ICI with zero steady-state collectives).  The node axis
+of the per-origin state can additionally be sharded ("model" style) for very
+large clusters; XLA/GSPMD inserts the all-reduce-min for the frontier
+relaxation and the all-to-alls for the edge sort automatically.
+"""
+
+from .mesh import make_mesh, shard_sim, state_shardings
+
+__all__ = ["make_mesh", "shard_sim", "state_shardings"]

@@ -1,0 +1,61 @@
+"""Mesh + sharding specs for ``SimState``.
+
+Replaces the reference's rayon thread-parallelism (gossip_main.rs:271,
+gossip.rs:747) and its *absent* distributed backend (SURVEY.md §2.3) with a
+``jax.sharding.Mesh`` over ('origins', 'nodes'):
+
+  * 'origins' — embarrassingly parallel batch of independent single-origin
+    sims; the primary scaling axis (shard O).
+  * 'nodes'   — optional second axis sharding the per-origin [N, ...] state;
+    GSPMD turns the scatter-min frontier relaxation into
+    local-scatter + all-reduce-min over ICI.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int = 0, node_shards: int = 1,
+              devices=None) -> Mesh:
+    """Build an ('origins', 'nodes') mesh over the first ``n_devices``."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices <= 0:
+        n_devices = len(devices)
+    devices = devices[:n_devices]
+    assert n_devices % node_shards == 0, (n_devices, node_shards)
+    arr = np.array(devices).reshape(n_devices // node_shards, node_shards)
+    return Mesh(arr, ("origins", "nodes"))
+
+
+def state_shardings(mesh: Mesh, shard_nodes: bool = True) -> dict:
+    """PartitionSpec per SimState field (field name -> spec)."""
+    n = "nodes" if shard_nodes else None
+    return {
+        "key": P("origins"),
+        "active": P("origins", n),
+        "pruned": P("origins", n),
+        "rc_src": P("origins", n),
+        "rc_score": P("origins", n),
+        "rc_upserts": P("origins", n),
+        "failed": P("origins", n),
+        "egress_acc": P("origins", n),
+        "ingress_acc": P("origins", n),
+        "prune_acc": P("origins", n),
+        "stranded_acc": P("origins", n),
+        "hops_hist_acc": P("origins"),
+    }
+
+
+def shard_sim(mesh: Mesh, state, origins, shard_nodes: bool = True):
+    """Place a SimState + origin vector onto the mesh."""
+    specs = state_shardings(mesh, shard_nodes)
+    state = type(state)(**{
+        f: jax.device_put(getattr(state, f), NamedSharding(mesh, specs[f]))
+        for f in specs})
+    origins = jax.device_put(origins, NamedSharding(mesh, P("origins")))
+    return state, origins

@@ -12,6 +12,13 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Some environments force-register an accelerator PJRT plugin via
+# sitecustomize and pin jax_platforms past the env var; override it at the
+# config level before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 from gossip_sim_tpu.identity import reset_unique_pubkeys  # noqa: E402
