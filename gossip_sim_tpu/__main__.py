@@ -1,0 +1,8 @@
+"""``python -m gossip_sim_tpu`` — the gossip-sim experiment driver
+(reference binary: gossip-sim, gossip_main.rs)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,79 @@
+"""Simulation-state checkpoint / resume.
+
+The reference has no checkpointing (SURVEY.md §5) — its closest artifact is
+the account-file snapshot (write_accounts_main.rs:118-125).  Long sweeps on
+TPU make resumability a cheap win: ``SimState`` is a flat pytree of arrays,
+so one ``.npz`` captures the whole simulation (active sets, prune bits,
+received caches, accumulators, RNG keys) plus the static params that shaped
+it.  Loading validates shape-defining params so a resumed run can't silently
+continue under a different compiled geometry.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_FORMAT_VERSION = 1
+
+# EngineParams fields that define array shapes; a mismatch makes the stored
+# state unusable under the new compile geometry.
+_SHAPE_FIELDS = ("num_nodes", "active_set_size", "rc_slots", "hist_bins")
+
+
+def save_state(path: str, state, params, config=None) -> None:
+    """Write SimState + EngineParams (+ optional Config) to one .npz."""
+    arrays = {f"state.{name}": np.asarray(getattr(state, name))
+              for name in state._fields}
+    meta = {
+        "format_version": _FORMAT_VERSION,
+        "params": dict(params._asdict()),
+    }
+    if config is not None:
+        cfg = dict(vars(config))
+        cfg["test_type"] = str(cfg["test_type"])
+        cfg["step_size"] = str(cfg["step_size"])
+        meta["config"] = cfg
+    np.savez_compressed(path, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    log.info("checkpoint saved: %s (%s arrays)", path, len(arrays))
+
+
+def load_state(path: str, params=None):
+    """Read a checkpoint -> (SimState-field dict, stored-params dict, meta).
+
+    If ``params`` is given, shape-defining fields are validated against the
+    stored ones and a mismatch raises ``ValueError``.
+    """
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        if meta.get("format_version") != _FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported checkpoint version {meta.get('format_version')}")
+        arrays = {k[len("state."):]: z[k] for k in z.files
+                  if k.startswith("state.")}
+    stored = meta["params"]
+    if params is not None:
+        for f in _SHAPE_FIELDS:
+            if getattr(params, f) != stored[f]:
+                raise ValueError(
+                    f"checkpoint {f}={stored[f]} != current {getattr(params, f)}")
+    return arrays, stored, meta
+
+
+def restore_sim_state(path: str, params=None):
+    """Read a checkpoint and rebuild a device-resident ``SimState``."""
+    import jax.numpy as jnp
+
+    from .engine import SimState
+
+    arrays, stored, meta = load_state(path, params)
+    missing = set(SimState._fields) - set(arrays)
+    if missing:
+        raise ValueError(f"checkpoint missing fields: {sorted(missing)}")
+    return SimState(**{f: jnp.asarray(arrays[f]) for f in SimState._fields}), \
+        stored, meta

@@ -1,0 +1,689 @@
+"""Experiment driver + sweep harness (reference: gossip_main.rs).
+
+Flag names, defaults and sweep semantics are the compatibility contract
+(gossip_main.rs:53-241,774-951).  Extensions beyond the reference surface
+(``--backend``, ``--seed``, ``--num-synthetic-nodes``, ``--all-origins``,
+``--origin-batch``) select the TPU engine, the deterministic RNG stream, and
+the origin-parallel vmap mode the reference lacks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+
+import numpy as np
+
+from .config import Config, StepSize, Testing
+from .constants import (AGGREGATE_HOPS_FAIL_NODES_HISTOGRAM_UPPER_BOUND,
+                        AGGREGATE_HOPS_MIN_INGRESS_NODES_HISTOGRAM_UPPER_BOUND,
+                        API_MAINNET_BETA, STANDARD_HISTOGRAM_UPPER_BOUND,
+                        UNREACHED,
+                        VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS,
+                        get_influx_url, get_json_rpc_url)
+from .identity import NodeIndex
+from .ingest import (fetch_vote_accounts_rpc, filter_accounts,
+                     load_accounts_yaml, log_cluster_summary,
+                     synthetic_accounts)
+from .oracle.rustrng import ChaChaRng
+from .sinks import (DatapointQueue, InfluxDataPoint, InfluxThread,
+                    load_dotenv)
+from .stats.gossip_stats import GossipStats, GossipStatsCollection
+
+log = logging.getLogger("gossip_sim_tpu")
+
+POOR_COVERAGE_THRESHOLD = 0.95  # gossip_main.rs:408
+
+
+def build_parser() -> argparse.ArgumentParser:
+    """The reference CLI surface (gossip_main.rs:53-241) + TPU extensions."""
+    p = argparse.ArgumentParser(
+        prog="gossip-sim",
+        description="TPU-native Solana gossip push-protocol simulator")
+    p.add_argument("--url", dest="json_rpc_url", default=API_MAINNET_BETA,
+                   metavar="URL_OR_MONIKER", help="solana's json rpc url")
+    p.add_argument("--account-file", default="", metavar="PATH",
+                   help="yaml of solana accounts to either read from or write to")
+    p.add_argument("--accounts-from-yaml", action="store_true",
+                   help="set to read in key/stake pairs from yaml. "
+                        "use with --account-file <path>")
+    p.add_argument("--filter-zero-staked-nodes", "-f", action="store_true",
+                   help="Filter out all zero-staked nodes")
+    p.add_argument("--push-fanout", type=int, default=6,
+                   help="gossip push fanout")
+    p.add_argument("--active-set-size", type=int, default=12,
+                   help="gossip push active set entry size")
+    p.add_argument("--iterations", type=int, default=1,
+                   help="gossip iterations")
+    p.add_argument("--origin-rank", type=int, nargs="+", default=[1],
+                   help="Select an origin with origin rank for gossip "
+                        "(1 = largest stake). Pass a list with "
+                        "--test-type origin-rank to sweep.")
+    p.add_argument("--rotation-probability", "-p", type=float, default=0.013333,
+                   help="After each round of gossip, rotate a node's active "
+                        "set with probability 0 <= p <= 1")
+    p.add_argument("--min-ingress-nodes", type=int, default=2,
+                   help="Minimum number of incoming peers a node must keep")
+    p.add_argument("--prune-stake-threshold", type=float, default=0.15,
+                   help="Ensure a node is connected to a minimum stake of "
+                        "prune_stake_threshold*node.stake()")
+    p.add_argument("--num-buckets-stranded", type=int, default=10,
+                   help="Number of buckets for the stranded node histogram")
+    p.add_argument("--num-buckets-message", type=int, default=5,
+                   help="Number of buckets for the ingress/egress message histograms")
+    p.add_argument("--num-buckets-hops", type=int, default=15,
+                   help="Number of buckets for the hops_stats histogram")
+    p.add_argument("--test-type", default="no-test",
+                   choices=[t.value for t in Testing],
+                   help="Type of sweep to run")
+    p.add_argument("--num-simulations", type=int, default=1,
+                   help="Number of simulations to run")
+    p.add_argument("--step-size", default="1",
+                   help="Size of step for test_type (int or float)")
+    p.add_argument("--fraction-to-fail", type=float, default=0.1,
+                   help="Fail fraction-to-fail of total nodes in cluster")
+    p.add_argument("--when-to-fail", type=int, default=0,
+                   help="On what iteration should the nodes fail")
+    p.add_argument("--warm-up-rounds", type=int, default=200,
+                   help="Number of gossip rounds to run before measuring statistics")
+    p.add_argument("--influx", default="n",
+                   help="Influx for reporting metrics. i for internal-metrics, "
+                        "l for localhost, n for none")
+    p.add_argument("--print-stats", action="store_true",
+                   help="Print Gossip Stats to console at end of simulation")
+    # ---- TPU-framework extensions --------------------------------------
+    p.add_argument("--backend", default="tpu", choices=["tpu", "oracle"],
+                   help="tpu = JAX engine; oracle = faithful CPU reference")
+    p.add_argument("--seed", type=int, default=42,
+                   help="Deterministic RNG seed (both backends)")
+    p.add_argument("--num-synthetic-nodes", type=int, default=0,
+                   help=">0: run on a synthetic seeded cluster instead of "
+                        "an account file / RPC")
+    p.add_argument("--all-origins", action="store_true",
+                   help="TPU backend: batch-simulate every node as origin "
+                        "(vmap over the origin axis)")
+    p.add_argument("--origin-batch", type=int, default=0,
+                   help="origins per device batch in --all-origins mode "
+                        "(0 = auto)")
+    p.add_argument("--checkpoint-path", default="",
+                   help="save the final simulation state (SimState arrays + "
+                        "params) to this .npz; reload via "
+                        "gossip_sim_tpu.checkpoint.restore_sim_state")
+    return p
+
+
+def config_from_args(args) -> Config:
+    prob = args.rotation_probability
+    if not 0.0 <= prob <= 1.0:
+        raise SystemExit("rotation-probability must be between 0 and 1")
+    if not 0.0 <= args.prune_stake_threshold <= 1.0:
+        raise SystemExit("prune-stake-threshold must be between 0 and 1")
+    return Config(
+        gossip_push_fanout=args.push_fanout,
+        gossip_active_set_size=args.active_set_size,
+        gossip_iterations=args.iterations,
+        accounts_from_file=args.accounts_from_yaml,
+        account_file=args.account_file,
+        origin_rank=args.origin_rank[0],
+        probability_of_rotation=prob,
+        prune_stake_threshold=args.prune_stake_threshold,
+        min_ingress_nodes=args.min_ingress_nodes,
+        filter_zero_staked_nodes=args.filter_zero_staked_nodes,
+        num_buckets_for_stranded_node_hist=args.num_buckets_stranded,
+        num_buckets_for_message_hist=args.num_buckets_message,
+        num_buckets_for_hops_stats_hist=args.num_buckets_hops,
+        fraction_to_fail=args.fraction_to_fail,
+        when_to_fail=args.when_to_fail,
+        test_type=Testing.parse(args.test_type),
+        num_simulations=args.num_simulations,
+        step_size=StepSize.parse(args.step_size),
+        warm_up_rounds=args.warm_up_rounds,
+        print_stats=args.print_stats,
+        backend=args.backend,
+        seed=args.seed,
+        num_synthetic_nodes=args.num_synthetic_nodes,
+        all_origins=args.all_origins,
+        origin_batch=args.origin_batch,
+        checkpoint_path=args.checkpoint_path,
+    )
+
+
+def find_nth_largest_node(n, items):
+    """Min-heap nth-largest-stake selection (gossip_main.rs:279-290).
+
+    ``items``: [(key, stake)]. Returns the first item whose stake equals the
+    nth largest stake value (duplicates counted separately).
+    """
+    import heapq
+    heap = []
+    for _, stake in items:
+        if len(heap) < n:
+            heapq.heappush(heap, stake)
+        elif stake >= heap[0]:
+            heapq.heapreplace(heap, stake)
+    if not heap:
+        return None
+    target = heap[0]
+    for item in items:
+        if item[1] == target:
+            return item
+    return None
+
+
+def load_cluster_accounts(config: Config, json_rpc_url: str):
+    """Resolve the account source (gossip_main.rs:302-328) -> ({pk: stake},
+    source label)."""
+    if config.num_synthetic_nodes > 0:
+        rng = ChaChaRng.from_seed_byte(config.seed % 256)
+        accounts = synthetic_accounts(config.num_synthetic_nodes, rng)
+        label = f"synthetic:{config.num_synthetic_nodes}"
+    elif config.accounts_from_file:
+        if not config.account_file:
+            log.error("need --account-file <path> with --accounts-from-yaml")
+            raise SystemExit(-1)
+        log.info("Reading %s", config.account_file)
+        accounts = load_accounts_yaml(config.account_file)
+        label = config.account_file
+    else:
+        url = get_json_rpc_url(json_rpc_url)
+        log.info("json_rpc_url: %s", url)
+        accounts = fetch_vote_accounts_rpc(url)
+        label = url
+    accounts = filter_accounts(accounts, config.filter_zero_staked_nodes)
+    log_cluster_summary(accounts)
+    return accounts, label
+
+
+# --------------------------------------------------------------------------
+# backends
+# --------------------------------------------------------------------------
+
+def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
+                        dp_queue, sim_iter, start_ts):
+    """The reference's per-iteration loop, verbatim, on the CPU oracle
+    (gossip_main.rs:425-565)."""
+    from .oracle.cluster import Cluster, Node
+
+    rng = ChaChaRng.from_seed_byte(config.seed % 256)
+    stakes = dict(accounts)
+    nodes = [Node(pk, stake) for pk, stake in accounts.items()]
+    node_map = {nd.pubkey: nd for nd in nodes}
+    log.info("Simulating Gossip and setting active sets. Please wait.....")
+    for node in nodes:
+        node.initialize_gossip(rng, stakes, config.gossip_active_set_size)
+    log.info("Simulation Complete!")
+
+    cluster = Cluster(config.gossip_push_fanout)
+    for it in range(config.gossip_iterations):
+        if it % 10 == 0:
+            log.info("GOSSIP ITERATION: %s", it)
+            _push_config_point(config, dp_queue, sim_iter, start_ts)
+        if config.test_type == Testing.FAIL_NODES and it == config.when_to_fail:
+            cluster.fail_nodes(config.fraction_to_fail, nodes, rng)
+            stats.set_failed_nodes(cluster.failed_nodes)
+        cluster.run_gossip(origin_pubkey, stakes, node_map)
+        cluster.consume_messages(origin_pubkey, nodes)
+        cluster.send_prunes(origin_pubkey, nodes, config.prune_stake_threshold,
+                            config.min_ingress_nodes, stakes)
+        cluster.prune_connections(node_map, stakes)
+        cluster.chance_to_rotate(rng, nodes, config.gossip_active_set_size,
+                                 stakes, config.probability_of_rotation)
+        if it + 1 == config.warm_up_rounds:
+            cluster.clear_message_counts()
+        if it >= config.warm_up_rounds:
+            steady = it - config.warm_up_rounds
+            coverage, n_stranded = cluster.coverage(stakes)
+            if coverage < POOR_COVERAGE_THRESHOLD:
+                log.warning("WARNING: poor coverage for origin: %s, %s",
+                            origin_pubkey, coverage)
+            stats.insert_coverage(coverage)
+            stats.insert_hops_stat(cluster.distances)
+            stats.insert_stranded_nodes(cluster.stranded_nodes(), stakes)
+            stats.calculate_outbound_branching_factor(cluster.pushes)
+            stats.update_message_counts(cluster.egress_message_count,
+                                        cluster.ingress_message_count)
+            stats.update_prune_counts(cluster.prune_messages_sent)
+            rmr_result = cluster.relative_message_redundancy()
+            stats.insert_rmr(rmr_result[0])
+            _push_iteration_points(config, dp_queue, sim_iter, start_ts,
+                                   stats, steady, coverage, rmr_result)
+    return stakes
+
+
+def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
+                     dp_queue, sim_iter, start_ts):
+    """The same simulation on the JAX engine: warm-up as one fused scan,
+    measured rounds harvested per-iteration into the stats layer."""
+    import jax
+    import jax.numpy as jnp
+
+    from .engine import (EngineParams, init_state, make_cluster_tables,
+                         run_rounds)
+
+    index = NodeIndex.from_stakes(accounts)
+    stakes = dict(accounts)
+    N = len(index)
+    params = EngineParams(
+        num_nodes=N,
+        push_fanout=config.gossip_push_fanout,
+        active_set_size=config.gossip_active_set_size,
+        probability_of_rotation=config.probability_of_rotation,
+        prune_stake_threshold=config.prune_stake_threshold,
+        min_ingress_nodes=config.min_ingress_nodes,
+        warm_up_rounds=config.warm_up_rounds,
+        fail_at=(config.when_to_fail
+                 if config.test_type == Testing.FAIL_NODES else -1),
+        fail_fraction=(config.fraction_to_fail
+                       if config.test_type == Testing.FAIL_NODES else 0.0),
+    )
+    tables = make_cluster_tables(index.stakes.astype(np.int64))
+    origin_idx = index.index_of(origin_pubkey)
+    origins = jnp.asarray([origin_idx], dtype=jnp.int32)
+
+    log.info("Simulating Gossip and setting active sets. Please wait.....")
+    state = init_state(jax.random.PRNGKey(config.seed), tables, origins, params)
+    log.info("Simulation Complete!")
+
+    def _record_failed():
+        failed_idx = np.nonzero(np.asarray(state.failed)[0])[0]
+        stats.set_failed_nodes({index.pubkeys[i] for i in failed_idx})
+
+    def _save_checkpoint():
+        if config.checkpoint_path:
+            from .checkpoint import save_state
+            save_state(config.checkpoint_path, state, params, config)
+
+    warm = min(config.warm_up_rounds, config.gossip_iterations)
+    if warm > 0:
+        # match the oracle loop's progress logs + influx config cadence
+        # (gossip_main.rs:426-447) without harvesting warm-up detail
+        for it in range(0, warm, 10):
+            log.info("GOSSIP ITERATION: %s", it)
+            _push_config_point(config, dp_queue, sim_iter, start_ts)
+        state, _ = run_rounds(params, tables, origins, state, warm)
+        if 0 <= params.fail_at < warm:
+            _record_failed()
+    measured = config.gossip_iterations - warm
+    if measured <= 0:
+        _save_checkpoint()
+        return stakes
+
+    # Harvest measured rounds in blocks to bound host-side detail arrays.
+    block = 256
+    done = 0
+    while done < measured:
+        n_it = min(block, measured - done)
+        start_it = warm + done
+        state, rows = run_rounds(params, tables, origins, state, n_it,
+                                 start_it=start_it, detail=True)
+        rows = jax.tree_util.tree_map(np.asarray, rows)
+        if params.fail_at >= 0 and start_it <= params.fail_at < start_it + n_it:
+            _record_failed()
+        for t in range(n_it):
+            it = start_it + t
+            if it % 10 == 0:
+                log.info("GOSSIP ITERATION: %s", it)
+                _push_config_point(config, dp_queue, sim_iter, start_ts)
+            steady = it - config.warm_up_rounds
+            coverage = float(rows["coverage"][t, 0])
+            if coverage < POOR_COVERAGE_THRESHOLD:
+                log.warning("WARNING: poor coverage for origin: %s, %s",
+                            origin_pubkey, coverage)
+            dist = rows["dist"][t, 0]            # [N], -1 = unreached
+            hops = np.where(dist < 0, UNREACHED, dist.astype(np.uint64))
+            stranded_mask = rows["stranded_mask"][t, 0]
+            stranded = [index.pubkeys[i] for i in np.nonzero(stranded_mask)[0]]
+            stats.insert_coverage(coverage)
+            stats.insert_hops_stat(hops.tolist())
+            stats.insert_stranded_nodes(stranded, stakes)
+            stats.insert_branching_factor(float(rows["branching"][t, 0]))
+            rmr_result = (float(rows["rmr"][t, 0]), int(rows["m"][t, 0]),
+                          int(rows["n"][t, 0]))
+            stats.insert_rmr(rmr_result[0])
+            _push_iteration_points(config, dp_queue, sim_iter, start_ts,
+                                   stats, steady, coverage, rmr_result)
+        done += n_it
+
+    # Message counters accumulate on-device across measured rounds; feed the
+    # trackers once (equals the reference's per-round cumulative updates).
+    egress = np.asarray(state.egress_acc)[0]
+    ingress = np.asarray(state.ingress_acc)[0]
+    prunes = np.asarray(state.prune_acc)[0]
+    stats.update_message_counts(
+        {index.pubkeys[i]: int(egress[i]) for i in range(N)},
+        {index.pubkeys[i]: int(ingress[i]) for i in range(N)})
+    stats.update_prune_counts(
+        {index.pubkeys[i]: int(prunes[i]) for i in range(N)})
+
+    _save_checkpoint()
+    return stakes
+
+
+def run_all_origins(config: Config, json_rpc_url: str) -> dict:
+    """Origin-parallel mode (TPU extension, SURVEY.md §2.3): every node is an
+    origin, vmapped in batches; per-iteration cross-origin aggregates.
+
+    Returns a summary dict (also logged)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .engine import (EngineParams, init_state, make_cluster_tables,
+                         run_rounds)
+
+    accounts, _ = load_cluster_accounts(config, json_rpc_url)
+    index = NodeIndex.from_stakes(accounts)
+    N = len(index)
+    params = EngineParams(
+        num_nodes=N,
+        push_fanout=config.gossip_push_fanout,
+        active_set_size=config.gossip_active_set_size,
+        probability_of_rotation=config.probability_of_rotation,
+        prune_stake_threshold=config.prune_stake_threshold,
+        min_ingress_nodes=config.min_ingress_nodes,
+        warm_up_rounds=config.warm_up_rounds,
+    )
+    tables = make_cluster_tables(index.stakes.astype(np.int64))
+    batch = config.origin_batch or max(1, min(64, (1 << 22) // max(N, 1)))
+    cov_sum = rmr_sum = 0.0
+    n_measured = 0
+    t0 = time.time()
+    for lo in range(0, N, batch):
+        origins = jnp.arange(lo, min(lo + batch, N), dtype=jnp.int32)
+        state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
+                           params)
+        state, rows = run_rounds(params, tables, origins, state,
+                                 config.gossip_iterations)
+        cov = np.asarray(rows["coverage"])[config.warm_up_rounds:]
+        rmr = np.asarray(rows["rmr"])[config.warm_up_rounds:]
+        cov_sum += float(cov.sum())
+        rmr_sum += float(rmr.sum())
+        n_measured += cov.size
+        log.info("all-origins: %s/%s origins done", min(lo + batch, N), N)
+    dt = time.time() - t0
+    summary = {
+        "num_nodes": N,
+        "num_origins": N,
+        "iterations": config.gossip_iterations,
+        "measured_points": n_measured,
+        "coverage_mean": cov_sum / max(n_measured, 1),
+        "rmr_mean": rmr_sum / max(n_measured, 1),
+        "elapsed_s": dt,
+        "origin_iters_per_sec": N * config.gossip_iterations / dt,
+    }
+    log.info("ALL-ORIGINS SUMMARY: %s", summary)
+    return summary
+
+
+# --------------------------------------------------------------------------
+# influx helpers
+# --------------------------------------------------------------------------
+
+def _push_config_point(config, dp_queue, sim_iter, start_ts):
+    if dp_queue is None:
+        return
+    dp = InfluxDataPoint(start_ts, sim_iter)
+    dp.create_config_point(
+        config.gossip_push_fanout, config.gossip_active_set_size,
+        config.origin_rank, config.prune_stake_threshold,
+        config.min_ingress_nodes, config.fraction_to_fail,
+        config.probability_of_rotation)
+    dp_queue.push_back(dp)
+
+
+def _push_iteration_points(config, dp_queue, sim_iter, start_ts, stats,
+                           steady, coverage, rmr_result):
+    if dp_queue is None:
+        return
+    dp = InfluxDataPoint(start_ts, sim_iter)
+    dp.create_rmr_data_point(rmr_result)
+    dp.create_data_point(coverage, "coverage")
+    dp.create_hops_stat_point(stats.get_hops_stat_by_iteration(steady))
+    dp.create_stranded_node_stat_point(
+        stats.get_stranded_node_stats_by_iteration(steady))
+    dp.create_data_point(
+        stats.get_outbound_branching_factor_by_index(steady),
+        "branching_factor")
+    dp.create_iteration_point(steady, sim_iter)
+    dp_queue.push_back(dp)
+
+
+def _push_end_of_sim_points(config, dp_queue, sim_iter, start_ts, stats):
+    if dp_queue is None:
+        return
+    dp = InfluxDataPoint(start_ts, sim_iter)
+    c = stats.stranded_node_collection
+    dp.create_stranded_iteration_point(
+        c.total_stranded_iterations,
+        c.stranded_iterations_per_node,
+        c.mean_stranded_per_iteration,
+        c.mean_stranded_iterations_per_stranded_node,
+        c.median_stranded_iterations_per_stranded_node,
+        c.weighted_stranded_node_mean_stake,
+        c.weighted_stranded_node_median_stake)
+    dp.create_histogram_point("stranded_node_histogram",
+                              stats.get_stranded_node_histogram())
+    dp.create_histogram_point("aggregate_hops_histogram",
+                              stats.get_aggregate_hop_stat_histogram())
+    dp.create_messages_point("egress_message_count",
+                             stats.get_egress_messages_histogram(), sim_iter)
+    dp.create_messages_point("ingress_message_count",
+                             stats.get_ingress_messages_histogram(), sim_iter)
+    dp.create_messages_point("prune_message_count",
+                             stats.get_prune_message_histogram(), sim_iter)
+    dp.create_iteration_point(0, sim_iter)
+    dp_queue.push_back(dp)
+
+
+# --------------------------------------------------------------------------
+# one simulation (gossip_main.rs:292-647)
+# --------------------------------------------------------------------------
+
+def run_simulation(config: Config, json_rpc_url: str,
+                   stats_collection: GossipStatsCollection,
+                   dp_queue, sim_iter: int, start_ts: str,
+                   start_value: float):
+    log.info("##### SIMULATION ITERATION: %s #####", sim_iter)
+    accounts, source_label = load_cluster_accounts(config, json_rpc_url)
+    log.info("%s", config)
+
+    if len(accounts) < config.origin_rank:
+        raise SystemExit(
+            f"ERROR: origin_rank larger than number of simulation nodes. "
+            f"nodes: {len(accounts)}, origin_rank: {config.origin_rank}")
+
+    origin = find_nth_largest_node(config.origin_rank, list(accounts.items()))
+    origin_pubkey = origin[0]
+    stakes = dict(accounts)
+    log.info("ORIGIN: %s", origin_pubkey)
+    log.info("Calculating the MSTs for origin: %s, stake: %s",
+             origin_pubkey, stakes[origin_pubkey])
+
+    stats = GossipStats()
+    stats.set_simulation_parameters(config)
+    stats.set_origin(origin_pubkey)
+    stats.initialize_message_stats(stakes)
+    stats.build_validator_stake_distribution_histogram(
+        VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS, stakes)
+
+    if sim_iter == 0 and dp_queue is not None:
+        dp = InfluxDataPoint(start_ts, sim_iter)
+        start = "N/A" if config.test_type == Testing.NO_TEST else str(start_value)
+        dp.create_test_type_point(
+            config.num_simulations, config.gossip_iterations,
+            config.warm_up_rounds, config.step_size, len(accounts),
+            config.probability_of_rotation, source_label, start,
+            config.test_type)
+        dp.create_validator_stake_distribution_histogram_point(
+            stats.get_validator_stake_distribution_histogram())
+        dp_queue.push_back(dp)
+
+    if dp_queue is not None:
+        dp = InfluxDataPoint(start_ts, sim_iter)
+        dp.set_start()
+        dp_queue.push_back(dp)
+
+    runner = (_run_oracle_backend if config.backend == "oracle"
+              else _run_tpu_backend)
+    stakes = runner(config, accounts, origin_pubkey, stats, dp_queue,
+                    sim_iter, start_ts)
+
+    if not stats.is_empty():
+        stats.build_stranded_node_histogram(
+            config.gossip_iterations - config.warm_up_rounds, 0,
+            config.num_buckets_for_stranded_node_hist)
+        if config.test_type == Testing.FAIL_NODES:
+            stats.build_aggregate_hops_stats_histogram(
+                int(AGGREGATE_HOPS_FAIL_NODES_HISTOGRAM_UPPER_BOUND
+                    * (1.0 + config.fraction_to_fail)),
+                0, config.num_buckets_for_hops_stats_hist)
+        elif config.test_type == Testing.MIN_INGRESS_NODES:
+            stats.build_aggregate_hops_stats_histogram(
+                AGGREGATE_HOPS_MIN_INGRESS_NODES_HISTOGRAM_UPPER_BOUND,
+                0, config.num_buckets_for_hops_stats_hist)
+        else:
+            stats.build_aggregate_hops_stats_histogram(
+                STANDARD_HISTOGRAM_UPPER_BOUND, 0,
+                config.num_buckets_for_hops_stats_hist)
+        stats.build_message_histograms(
+            config.num_buckets_for_message_hist, True, stakes)
+        stats.build_prune_histogram(
+            config.num_buckets_for_message_hist, True, stakes)
+        stats.run_all_calculations()
+        stats_collection.push(stats)
+        _push_end_of_sim_points(config, dp_queue, sim_iter, start_ts, stats)
+
+
+# --------------------------------------------------------------------------
+# sweep dispatch (gossip_main.rs:774-951)
+# --------------------------------------------------------------------------
+
+def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
+                    collection: GossipStatsCollection, dp_queue,
+                    start_ts: str):
+    tt = config.test_type
+    for i in range(config.num_simulations):
+        if tt == Testing.ACTIVE_SET_SIZE:
+            v = config.gossip_active_set_size + i * config.step_size.as_int()
+            c = config.stepped(gossip_active_set_size=v)
+            start = float(config.gossip_active_set_size)
+        elif tt == Testing.PUSH_FANOUT:
+            v = config.gossip_push_fanout + i * config.step_size.as_int()
+            c = config.stepped(gossip_push_fanout=v)
+            # fanout beyond the active set would silently cap (gossip_main.rs:812)
+            if v > c.gossip_active_set_size:
+                c = c.stepped(gossip_active_set_size=v)
+            start = float(config.gossip_push_fanout)
+        elif tt == Testing.MIN_INGRESS_NODES:
+            v = config.min_ingress_nodes + i * config.step_size.as_int()
+            c = config.stepped(min_ingress_nodes=v)
+            start = float(v)  # reference reports the stepped value here
+        elif tt == Testing.PRUNE_STAKE_THRESHOLD:
+            v = config.prune_stake_threshold + i * config.step_size.as_float()
+            c = config.stepped(prune_stake_threshold=v)
+            start = float(config.prune_stake_threshold)
+        elif tt == Testing.ORIGIN_RANK:
+            c = config.stepped(origin_rank=origin_ranks[i])
+            start = float(origin_ranks[i])
+        elif tt == Testing.FAIL_NODES:
+            v = config.fraction_to_fail + i * config.step_size.as_float()
+            c = config.stepped(fraction_to_fail=v)
+            start = float(config.fraction_to_fail)
+        elif tt == Testing.ROTATE_PROBABILITY:
+            v = (config.probability_of_rotation
+                 + i * config.step_size.as_float())
+            c = config.stepped(probability_of_rotation=v)
+            start = float(config.probability_of_rotation)
+        else:  # NO_TEST
+            c, start = config, 0.0
+        run_simulation(c, json_rpc_url, collection, dp_queue, i, start_ts,
+                       start)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="[%(asctime)s %(levelname)s %(name)s] %(message)s")
+    args = build_parser().parse_args(argv)
+    config = config_from_args(args)
+    origin_ranks = args.origin_rank
+
+    # origin-rank count validation (gossip_main.rs:706-716)
+    if len(origin_ranks) < config.num_simulations:
+        log.error("ERROR: not enough origin ranks provided for "
+                  "num_simulations! origin_ranks: %s, num_simulations: %s",
+                  len(origin_ranks), config.num_simulations)
+        if config.test_type == Testing.ORIGIN_RANK:
+            return 1
+    elif len(origin_ranks) > config.num_simulations:
+        log.warning("WARNING: more origin ranks than number of simulations. "
+                    "Not going to hit all origin ranks")
+    elif (len(origin_ranks) > 1
+          and config.test_type != Testing.ORIGIN_RANK):
+        log.error("ERROR: multiple origin_ranks passed in but test type is "
+                  "not OriginRank. This would end up running all simulations "
+                  "with origin_rank[0]: %s", origin_ranks[0])
+        return 1
+
+    if config.gossip_iterations <= config.warm_up_rounds:
+        log.warning("WARNING: Gossip Iterations (%s) <= Warm Up Rounds (%s). "
+                    "No stats will be recorded....",
+                    config.gossip_iterations, config.warm_up_rounds)
+
+    start_ts = str(time.time_ns())
+    log.info("############################################")
+    log.info("##### START_TIME: %s ######", start_ts)
+    log.info("############################################")
+
+    dp_queue = None
+    influx_thread = None
+    if args.influx in ("l", "i"):
+        import os
+        dp_queue = DatapointQueue()
+        load_dotenv()
+        try:
+            username = os.environ["GOSSIP_SIM_INFLUX_USERNAME"]
+            password = os.environ["GOSSIP_SIM_INFLUX_PASSWORD"]
+            database = os.environ["GOSSIP_SIM_INFLUX_DATABASE"]
+        except KeyError as e:
+            log.error("%s is not set", e.args[0])
+            return 1
+        influx_thread = InfluxThread.spawn(
+            get_influx_url(args.influx), username, password, database,
+            dp_queue)
+
+    if config.all_origins:
+        if config.backend != "tpu":
+            log.error("--all-origins requires --backend tpu")
+            return 1
+        run_all_origins(config, args.json_rpc_url)
+        return 0
+
+    collection = GossipStatsCollection()
+    collection.set_number_of_simulations(config.num_simulations)
+    dispatch_sweeps(config, args.json_rpc_url, origin_ranks, collection,
+                    dp_queue, start_ts)
+
+    if dp_queue is not None:
+        dp = InfluxDataPoint()
+        dp.set_last_datapoint()
+        dp_queue.push_back(dp)
+        if influx_thread is not None:
+            influx_thread.join()
+
+    if config.print_stats:
+        if not collection.is_empty():
+            collection.print_all(config.gossip_iterations,
+                                 config.warm_up_rounds, config.test_type)
+        else:
+            log.warning("WARNING: Gossip Stats Collection is empty. "
+                        "Is `Iterations` <= `warm-up-rounds`?")
+    log.info("############################################")
+    log.info("##### START_TIME: %s ######", start_ts)
+    log.info("############################################")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

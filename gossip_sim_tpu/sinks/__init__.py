@@ -1,0 +1,7 @@
+"""Metrics sinks (reference: influx_db.rs)."""
+
+from .influx import (DatapointQueue, InfluxDataPoint, InfluxDB, InfluxThread,
+                     Tracker, load_dotenv)
+
+__all__ = ["DatapointQueue", "InfluxDataPoint", "InfluxDB", "InfluxThread",
+           "Tracker", "load_dotenv"]

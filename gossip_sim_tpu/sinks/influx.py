@@ -1,0 +1,334 @@
+"""InfluxDB line-protocol sink (reference: influx_db.rs).
+
+A background reporter thread polls a shared datapoint queue every 100 ms
+(1 ms once the ``start`` sentinel arrives) and POSTs line-protocol strings to
+InfluxDB's ``/write`` endpoint with basic auth (influx_db.rs:148-206,36-97).
+The ``end`` sentinel plus a dequeued==sent tracker drains the queue before
+exit (influx_db.rs:23,100-144,189-202) — here the tracker is a plain locked
+object rather than the reference's ``static mut`` accessed under ``unsafe``
+(a hazard SURVEY.md §5 flags as not worth carrying forward).
+
+Series and field names are the compatibility contract
+(influx_db.rs:252-603): ``rmr``, ``coverage``/``branching_factor`` (generic
+``data``), ``hops_stat``, ``stranded_node_stats``, ``iteration``,
+``simulation_config``, ``validator_stake_distribution``, ``config``,
+``stranded_node_iterations``, ``stranded_node_histogram``,
+``aggregate_hops_histogram``, ``{egress,ingress,prune}_message_count``.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import os
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from collections import deque
+
+log = logging.getLogger(__name__)
+
+
+def load_dotenv(path: str = ".env") -> bool:
+    """Minimal dotenv: KEY=VALUE lines -> os.environ (existing keys win).
+
+    Replaces the reference's ``dotenv::dotenv()`` (gossip_main.rs:244-246).
+    """
+    if not os.path.exists(path):
+        return False
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or "=" not in line:
+                continue
+            key, _, value = line.partition("=")
+            os.environ.setdefault(key.strip(), value.strip().strip("'\""))
+    return True
+
+
+def get_timestamp_now() -> str:
+    """Nanosecond timestamp + newline (influx_db.rs:25-32)."""
+    return f"{time.time_ns()}\n"
+
+
+class DatapointQueue:
+    """Shared FIFO between the simulation and the reporter thread
+    (the reference's ``Arc<Mutex<VecDeque<InfluxDataPoint>>>``,
+    gossip_main.rs:730-769)."""
+
+    def __init__(self):
+        self._dq = deque()
+        self._lock = threading.Lock()
+
+    def push_back(self, dp: "InfluxDataPoint") -> None:
+        with self._lock:
+            self._dq.append(dp)
+
+    def pop_front(self):
+        with self._lock:
+            return self._dq.popleft() if self._dq else None
+
+    def __len__(self):
+        with self._lock:
+            return len(self._dq)
+
+
+class Tracker:
+    """dequeued==sent drain tracker (influx_db.rs:100-144)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.dequeued = 0
+        self.sent = 0
+
+    def add_dequeued(self):
+        with self._lock:
+            self.dequeued += 1
+
+    def add_sent(self):
+        with self._lock:
+            self.sent += 1
+
+    def equal(self) -> bool:
+        with self._lock:
+            return self.sent == self.dequeued
+
+
+class InfluxDataPoint:
+    """Line-protocol string builder (influx_db.rs:252-603)."""
+
+    def __init__(self, start_timestamp: str = "0", simulation_iter: int = 0):
+        self.datapoint = ""
+        self.timestamp = get_timestamp_now()
+        self.simulation_iteration = simulation_iter
+        self.start_timestamp = start_timestamp
+
+    def data(self) -> str:
+        return self.datapoint
+
+    # -- sentinels (influx_db.rs:290-318) ---------------------------------
+
+    def set_start(self):
+        self.datapoint += "start"
+
+    def is_start(self) -> bool:
+        return self.datapoint == "start"
+
+    def set_last_datapoint(self):
+        self.datapoint += "end"
+
+    def last_datapoint(self) -> bool:
+        return self.datapoint == "end"
+
+    # -- timestamps -------------------------------------------------------
+
+    def get_timestamp_now(self) -> str:
+        # 1 us sleep so consecutive points never collide on the same ns
+        # timestamp (influx takes only one of equal-timestamp points,
+        # influx_db.rs:320-332).
+        time.sleep(1e-6)
+        return get_timestamp_now()
+
+    def append_timestamp(self):
+        self.datapoint += self.timestamp
+
+    def set_and_append_timestamp(self):
+        self.datapoint += self.get_timestamp_now()
+
+    # -- series builders (influx_db.rs:346-602) ---------------------------
+
+    def create_rmr_data_point(self, result):
+        rmr, m, n = result
+        self.datapoint += (
+            f"rmr,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} rmr={rmr},m={m},n={n} ")
+        self.append_timestamp()
+
+    def create_data_point(self, data: float, stat_type: str):
+        self.datapoint += (
+            f"{stat_type},simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} data={data} ")
+        self.append_timestamp()
+
+    def create_hops_stat_point(self, stat):
+        self.datapoint += (
+            f"hops_stat,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"mean={stat.mean},median={stat.median},max={stat.max} ")
+        self.append_timestamp()
+
+    def create_stranded_node_stat_point(self, stat):
+        self.datapoint += (
+            f"stranded_node_stats,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"count={stat.count},mean={stat.mean_stake},"
+            f"median={stat.median_stake},max={stat.max_stake},"
+            f"min={stat.min_stake} ")
+        self.append_timestamp()
+
+    def create_iteration_point(self, gossip_iter: int, simulation_iter_val: int):
+        self.datapoint += (
+            f"iteration,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"gossip_iter={gossip_iter},simulation_iter_val={simulation_iter_val} ")
+        self.append_timestamp()
+
+    def create_test_type_point(self, num_simulations, gossip_iterations,
+                               warm_up_rounds, step_size, node_count,
+                               probability_of_rotation, api, start_value,
+                               test_type):
+        self.datapoint += (
+            f"simulation_config,start_time={self.start_timestamp} "
+            f"num_simulations={num_simulations},"
+            f"gossip_iterations_per_simulation={gossip_iterations},"
+            f"warm_up_rounds={warm_up_rounds},"
+            f"step_size={step_size},"
+            f"node_count={node_count},"
+            f"probability_of_rotation={probability_of_rotation},"
+            f"api=\"{api}\","
+            f"start_value=\"{start_value}\","
+            f"test_type=\"{test_type}\" ")
+        self.append_timestamp()
+
+    def create_validator_stake_distribution_histogram_point(self, histogram):
+        for bucket, count in histogram.items():
+            self.datapoint += (
+                f"validator_stake_distribution,"
+                f"start_time={self.start_timestamp} "
+                f"bucket={bucket},count={count} ")
+            self.set_and_append_timestamp()
+
+    def create_config_point(self, push_fanout, active_set_size, origin_rank,
+                            prune_stake_threshold, min_ingress_nodes,
+                            fraction_to_fail, rotation_probability):
+        self.datapoint += (
+            f"config,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"push_fanout={push_fanout},"
+            f"active_set_size={active_set_size},"
+            f"origin_rank={origin_rank},"
+            f"prune_stake_threshold={prune_stake_threshold},"
+            f"min_ingress_nodes={min_ingress_nodes},"
+            f"fraction_to_fail={fraction_to_fail},"
+            f"rotation_probability={rotation_probability} ")
+        self.append_timestamp()
+
+    def create_stranded_iteration_point(self, total_stranded,
+                                        mean_iter_stranded_per_node,
+                                        mean_stranded_per_iter,
+                                        mean_iter_stranded,
+                                        median_iter_stranded,
+                                        mean_weighted_stake,
+                                        median_weighted_stake):
+        self.datapoint += (
+            f"stranded_node_iterations,"
+            f"simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"total_stranded={total_stranded},"
+            f"mean_iter_stranded_per_node={mean_iter_stranded_per_node},"
+            f"mean_stranded_per_iter={mean_stranded_per_iter},"
+            f"mean_iter_stranded={mean_iter_stranded},"
+            f"median_iter_stranded={median_iter_stranded},"
+            f"mean_weighted_stake={mean_weighted_stake},"
+            f"median_weighted_stake={median_weighted_stake} ")
+        self.append_timestamp()
+
+    def create_histogram_point(self, data_type: str, histogram):
+        for bucket, count in histogram.items():
+            bucket_max = histogram.min_entry + (bucket + 1) * histogram.bucket_range - 1
+            self.datapoint += f"{data_type} bucket={bucket_max},count={count} "
+            self.set_and_append_timestamp()
+
+    def create_messages_point(self, messages_direction: str, messages,
+                              simulation_iter_val: int):
+        for bucket, count in messages.items():
+            self.datapoint += (
+                f"{messages_direction},simulation_iter={simulation_iter_val},"
+                f"start_time={self.start_timestamp} "
+                f"bucket={bucket},count={count} ")
+            self.set_and_append_timestamp()
+
+
+class InfluxDB:
+    """HTTP POST of line protocol to /write?db=... with basic auth
+    (influx_db.rs:36-97,205-250)."""
+
+    def __init__(self, endpoint: str, username: str, password: str,
+                 database: str, tracker: Tracker | None = None,
+                 timeout: float = 10.0):
+        self.url = endpoint.rstrip("/") + "/write"
+        self.database = database
+        self.username = username
+        self.password = password
+        self.tracker = tracker
+        self.timeout = timeout
+
+    def _post(self, body: str):
+        url = f"{self.url}?{urllib.parse.urlencode({'db': self.database})}"
+        auth = base64.b64encode(
+            f"{self.username}:{self.password}".encode()).decode()
+        req = urllib.request.Request(
+            url, data=body.encode(),
+            headers={"Authorization": f"Basic {auth}"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                if not (200 <= resp.status < 300):
+                    log.error("Failed to report data to InfluxDB. Status: %s",
+                              resp.status)
+        except (urllib.error.URLError, OSError) as err:
+            log.error("Error reporting to InfluxDB: %s", err)
+        finally:
+            if self.tracker is not None:
+                self.tracker.add_sent()
+
+    def send_data_points(self, datapoint: InfluxDataPoint):
+        # Fire-and-forget sender thread (the reference spawns an async_std
+        # task per point, influx_db.rs:81-96).
+        threading.Thread(target=self._post, args=(datapoint.data(),),
+                         daemon=True).start()
+
+
+class InfluxThread:
+    """Reporter loop (influx_db.rs:146-204)."""
+
+    @staticmethod
+    def start(endpoint: str, username: str, password: str, database: str,
+              datapoint_queue: DatapointQueue):
+        tracker = Tracker()
+        influx_db = InfluxDB(endpoint, username, password, database, tracker)
+        wait_time = 0.1
+        rx_last_datapoint = False
+        draining_logged = False
+        while True:
+            dp = datapoint_queue.pop_front()
+            if dp is not None:
+                if dp.last_datapoint():
+                    rx_last_datapoint = True
+                elif dp.is_start():
+                    wait_time = 0.001
+                else:
+                    influx_db.send_data_points(dp)
+                    tracker.add_dequeued()
+            if rx_last_datapoint:
+                if not draining_logged:
+                    draining_logged = True
+                    log.info("Last simulation datapoint recorded. "
+                             "Draining Queue...")
+                if tracker.equal():
+                    log.info("Queue Drained. Exiting...")
+                    break
+            time.sleep(wait_time)
+
+    @staticmethod
+    def spawn(endpoint: str, username: str, password: str, database: str,
+              datapoint_queue: DatapointQueue) -> threading.Thread:
+        """Convenience: run ``start`` in a daemon thread and return it
+        (the reference's std::thread::spawn, gossip_main.rs:746-768)."""
+        t = threading.Thread(
+            target=InfluxThread.start,
+            args=(endpoint, username, password, database, datapoint_queue),
+            daemon=True)
+        t.start()
+        return t

@@ -33,7 +33,10 @@ class Histogram:
             log.warning("histogram: max and min entries equal or off by 1")
             self.bucket_range = 1
         else:
-            self.bucket_range = (self.max_entry - self.min_entry) // self.num_buckets
+            # floor to >= 1: the reference divides by an unchecked u64 range
+            # (gossip_stats.rs:588) and would panic when range < num_buckets
+            self.bucket_range = max(
+                1, (self.max_entry - self.min_entry) // self.num_buckets)
         self.entries = {b: 0 for b in range(self.num_buckets)}
         for v in values:
             v = int(v)

@@ -1,0 +1,63 @@
+"""Checkpoint/resume tests: a restored SimState must continue bit-identically
+(a capability the reference lacks, SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_sim_tpu.checkpoint import restore_sim_state, save_state
+from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                   make_cluster_tables, run_rounds)
+
+
+def _setup(n=48, o=2, seed=3):
+    rng = np.random.default_rng(seed)
+    stakes = rng.integers(1, 1 << 16, n).astype(np.int64) * 1_000_000_000
+    tables = make_cluster_tables(stakes)
+    params = EngineParams(num_nodes=n, warm_up_rounds=0)
+    origins = jnp.arange(o, dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(seed), tables, origins, params)
+    return params, tables, origins, state
+
+
+def test_roundtrip_resume_is_bit_identical(tmp_path):
+    params, tables, origins, state = _setup()
+    state, _ = run_rounds(params, tables, origins, state, 3)
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, params)
+
+    # continue directly vs continue from the restored checkpoint
+    cont_state, cont_rows = run_rounds(params, tables, origins, state, 4,
+                                       start_it=3)
+    restored, stored_params, _ = restore_sim_state(path, params)
+    res_state, res_rows = run_rounds(params, tables, origins, restored, 4,
+                                     start_it=3)
+
+    assert stored_params["num_nodes"] == params.num_nodes
+    for k in cont_rows:
+        np.testing.assert_array_equal(np.asarray(cont_rows[k]),
+                                      np.asarray(res_rows[k]), err_msg=k)
+    for f in cont_state._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(cont_state, f)),
+                                      np.asarray(getattr(res_state, f)),
+                                      err_msg=f)
+
+
+def test_shape_param_mismatch_rejected(tmp_path):
+    params, tables, origins, state = _setup()
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, params)
+    wrong = params._replace(num_nodes=params.num_nodes + 1)
+    with pytest.raises(ValueError, match="num_nodes"):
+        restore_sim_state(path, wrong)
+
+
+def test_config_metadata_round_trips(tmp_path):
+    from gossip_sim_tpu.config import Config
+
+    params, tables, origins, state = _setup()
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, params, Config(gossip_push_fanout=9))
+    _, _, meta = restore_sim_state(path, params)
+    assert meta["config"]["gossip_push_fanout"] == 9

@@ -1,0 +1,156 @@
+"""CLI driver + sweep harness tests (reference: gossip_main.rs:53-241,
+279-290, 706-716, 774-951)."""
+
+import numpy as np
+import pytest
+
+from gossip_sim_tpu.cli import (build_parser, config_from_args,
+                                dispatch_sweeps, find_nth_largest_node,
+                                main, run_simulation)
+from gossip_sim_tpu.config import Config, StepSize, Testing
+from gossip_sim_tpu.identity import pubkey_new_unique
+from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+
+
+def test_default_flags_match_reference():
+    """Defaults are the compatibility contract (gossip_main.rs:90-241)."""
+    args = build_parser().parse_args([])
+    cfg = config_from_args(args)
+    assert cfg.gossip_push_fanout == 6
+    assert cfg.gossip_active_set_size == 12
+    assert cfg.gossip_iterations == 1
+    assert cfg.origin_rank == 1
+    assert cfg.probability_of_rotation == pytest.approx(0.013333)
+    assert cfg.min_ingress_nodes == 2
+    assert cfg.prune_stake_threshold == pytest.approx(0.15)
+    assert cfg.warm_up_rounds == 200
+    assert cfg.num_buckets_for_stranded_node_hist == 10
+    assert cfg.num_buckets_for_message_hist == 5
+    assert cfg.num_buckets_for_hops_stats_hist == 15
+    assert cfg.fraction_to_fail == pytest.approx(0.1)
+    assert cfg.when_to_fail == 0
+    assert cfg.test_type == Testing.NO_TEST
+    assert cfg.num_simulations == 1
+
+
+def test_probability_validator():
+    args = build_parser().parse_args(["--rotation-probability", "1.5"])
+    with pytest.raises(SystemExit):
+        config_from_args(args)
+
+
+def test_find_nth_largest_reference_golden():
+    """Golden vectors from gossip_main.rs:1056-1069."""
+    stakes = [10, 123, 67, 18, 29, 567, 12, 5, 875, 234, 12, 5, 76, 0,
+              12354, 985]
+    items = [(pubkey_new_unique(), s) for s in stakes]
+    for rank, want in zip([5, 10, 12, 1, 6, 2, 9, 16],
+                          [234, 18, 12, 12354, 123, 985, 29, 0]):
+        assert find_nth_largest_node(rank, items)[1] == want
+
+
+def _base_config(**kw):
+    defaults = dict(gossip_iterations=12, warm_up_rounds=4,
+                    gossip_push_fanout=3, num_synthetic_nodes=40,
+                    backend="oracle", seed=7)
+    defaults.update(kw)
+    return Config(**defaults)
+
+
+def _run(config):
+    coll = GossipStatsCollection()
+    coll.set_number_of_simulations(config.num_simulations)
+    run_simulation(config, "unused", coll, None, 0, "123", 0.0)
+    return coll
+
+
+@pytest.mark.parametrize("backend", ["oracle", "tpu"])
+def test_run_simulation_end_to_end(backend):
+    coll = _run(_base_config(backend=backend))
+    assert len(coll.collection) == 1
+    stats = coll.collection[0]
+    measured = 12 - 4
+    assert len(stats.coverage_stats.collection) == measured
+    assert len(stats.rmr_stats.collection) == measured
+    assert 0.0 < stats.coverage_stats.mean <= 1.0
+    assert stats.rmr_stats.mean >= 0.0
+    assert stats.hops_stats.aggregate_stats.max >= 1
+    # message counters flowed into the trackers
+    assert sum(stats.egress_messages.counts.values()) > 0
+    assert sum(stats.ingress_messages.counts.values()) > 0
+
+
+def test_backend_stats_parity():
+    """Same cluster, both backends: pre-prune RMR and coverage agree
+    (statistical parity, SURVEY.md §4)."""
+    cov, rmr = {}, {}
+    for backend in ("oracle", "tpu"):
+        coll = _run(_base_config(backend=backend, gossip_iterations=6,
+                                 warm_up_rounds=0, gossip_push_fanout=5))
+        s = coll.collection[0]
+        cov[backend] = s.coverage_stats.mean
+        rmr[backend] = s.rmr_stats.collection[0]  # round 0: no prunes yet
+    assert cov["oracle"] == pytest.approx(cov["tpu"], abs=0.1)
+    # fanout saturated on a full cluster: m = F * n_reached both sides
+    assert rmr["oracle"] == pytest.approx(rmr["tpu"], abs=0.35)
+
+
+def test_sweep_dispatch_steps_parameters(monkeypatch):
+    calls = []
+    monkeypatch.setattr("gossip_sim_tpu.cli.run_simulation",
+                        lambda c, url, coll, q, i, ts, sv: calls.append(c))
+    cfg = _base_config(test_type=Testing.PUSH_FANOUT, num_simulations=3,
+                       step_size=StepSize(4, True), gossip_push_fanout=6,
+                       gossip_active_set_size=12)
+    dispatch_sweeps(cfg, "u", [1], GossipStatsCollection(), None, "0")
+    assert [c.gossip_push_fanout for c in calls] == [6, 10, 14]
+    # fanout > active-set-size bumps the set size (gossip_main.rs:812)
+    assert [c.gossip_active_set_size for c in calls] == [12, 12, 14]
+
+
+def test_sweep_dispatch_origin_rank(monkeypatch):
+    calls = []
+    monkeypatch.setattr("gossip_sim_tpu.cli.run_simulation",
+                        lambda c, url, coll, q, i, ts, sv: calls.append(c))
+    cfg = _base_config(test_type=Testing.ORIGIN_RANK, num_simulations=3)
+    dispatch_sweeps(cfg, "u", [1, 5, 9], GossipStatsCollection(), None, "0")
+    assert [c.origin_rank for c in calls] == [1, 5, 9]
+
+
+def test_origin_rank_count_validation():
+    """Multiple ranks without origin-rank test type is an error
+    (gossip_main.rs:713-716)."""
+    rc = main(["--origin-rank", "1", "2", "--num-simulations", "2",
+               "--num-synthetic-nodes", "10", "--iterations", "1"])
+    assert rc == 1
+
+
+@pytest.mark.parametrize("backend", ["oracle", "tpu"])
+def test_fail_nodes_sweep_end_to_end(backend):
+    # when_to_fail=0 fires inside the warm-up phase: failed nodes must still
+    # be recorded (the TPU warm-up runs as one fused scan)
+    cfg = _base_config(backend=backend, test_type=Testing.FAIL_NODES,
+                       fraction_to_fail=0.2, when_to_fail=0,
+                       gossip_iterations=8, warm_up_rounds=2,
+                       step_size=StepSize(0.1, False))
+    coll = _run(cfg)
+    stats = coll.collection[0]
+    assert len(stats.failed_nodes) == int(0.2 * 40)
+    # failed nodes are excluded from stranded counts (gossip.rs:334-344)
+    stranded = stats.stranded_node_collection.stranded_nodes
+    assert not (set(stranded) & stats.failed_nodes)
+
+
+def test_checkpoint_saved_even_when_all_warmup(tmp_path):
+    path = str(tmp_path / "warm.npz")
+    cfg = _base_config(backend="tpu", gossip_iterations=3, warm_up_rounds=5,
+                       checkpoint_path=path)
+    _run(cfg)
+    import os
+    assert os.path.exists(path)
+
+
+def test_origin_rank_larger_than_cluster_exits():
+    cfg = _base_config(origin_rank=1000)
+    with pytest.raises(SystemExit):
+        _run(cfg)

@@ -1,0 +1,149 @@
+"""Influx sink tests: line-protocol schema (influx_db.rs:252-603) and the
+reporter thread's start/end-sentinel drain loop (influx_db.rs:146-204)."""
+
+import http.server
+import threading
+import time
+
+from gossip_sim_tpu.sinks import (DatapointQueue, InfluxDataPoint,
+                                  InfluxThread)
+from gossip_sim_tpu.stats.histogram import Histogram
+from gossip_sim_tpu.stats.hops import HopsStat
+
+
+def test_rmr_line_protocol():
+    dp = InfluxDataPoint("1234", 2)
+    dp.create_rmr_data_point((2.5, 10, 5))
+    assert dp.data().startswith(
+        "rmr,simulation_iter=2,start_time=1234 rmr=2.5,m=10,n=5 ")
+    assert dp.data().endswith("\n")
+
+
+def test_generic_data_point():
+    dp = InfluxDataPoint("7", 0)
+    dp.create_data_point(0.98, "coverage")
+    assert dp.data().startswith(
+        "coverage,simulation_iter=0,start_time=7 data=0.98 ")
+
+
+def test_hops_stat_point():
+    dp = InfluxDataPoint("7", 1)
+    dp.create_hops_stat_point(HopsStat([2, 3, 4]))
+    assert dp.data().startswith(
+        "hops_stat,simulation_iter=1,start_time=7 mean=3.0,median=3.0,max=4 ")
+
+
+def test_config_point_fields():
+    dp = InfluxDataPoint("9", 0)
+    dp.create_config_point(6, 12, 1, 0.15, 2, 0.1, 0.013333)
+    line = dp.data()
+    for frag in ("config,simulation_iter=0,start_time=9 ", "push_fanout=6",
+                 "active_set_size=12", "origin_rank=1",
+                 "prune_stake_threshold=0.15", "min_ingress_nodes=2",
+                 "fraction_to_fail=0.1", "rotation_probability=0.013333"):
+        assert frag in line
+
+
+def test_iteration_and_sentinels():
+    dp = InfluxDataPoint("5", 3)
+    dp.create_iteration_point(42, 3)
+    assert "iteration,simulation_iter=3,start_time=5 " in dp.data()
+    assert "gossip_iter=42,simulation_iter_val=3 " in dp.data()
+
+    start = InfluxDataPoint()
+    start.set_start()
+    assert start.is_start() and not start.last_datapoint()
+    end = InfluxDataPoint()
+    end.set_last_datapoint()
+    assert end.last_datapoint() and not end.is_start()
+
+
+def test_histogram_points_emit_one_line_per_bucket():
+    h = Histogram()
+    h.build(30, 0, 3, [1, 5, 25])
+    dp = InfluxDataPoint("11", 0)
+    dp.create_histogram_point("aggregate_hops_histogram", h)
+    lines = [ln for ln in dp.data().splitlines() if ln]
+    assert len(lines) == 3
+    assert all(ln.startswith("aggregate_hops_histogram bucket=")
+               for ln in lines)
+
+    dp2 = InfluxDataPoint("11", 0)
+    dp2.create_messages_point("egress_message_count", h, 4)
+    lines2 = [ln for ln in dp2.data().splitlines() if ln]
+    assert len(lines2) == 3
+    assert all(ln.startswith("egress_message_count,simulation_iter=4,"
+                             "start_time=11 bucket=") for ln in lines2)
+
+
+def test_timestamps_never_collide():
+    dp = InfluxDataPoint("1", 0)
+    h = Histogram()
+    h.build(10, 0, 5, [1, 3, 5, 7, 9])
+    dp.create_histogram_point("x", h)
+    ts = [int(ln.rsplit(" ", 1)[1]) for ln in dp.data().splitlines() if ln]
+    assert len(set(ts)) == len(ts)
+
+
+class _CapturingHandler(http.server.BaseHTTPRequestHandler):
+    received = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        _CapturingHandler.received.append(
+            (self.path, body.decode(), self.headers.get("Authorization", "")))
+        self.send_response(204)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+def test_reporter_thread_posts_and_drains():
+    _CapturingHandler.received = []
+    server = http.server.HTTPServer(("127.0.0.1", 0), _CapturingHandler)
+    port = server.server_address[1]
+    srv_thread = threading.Thread(target=server.serve_forever, daemon=True)
+    srv_thread.start()
+    try:
+        q = DatapointQueue()
+        start = InfluxDataPoint()
+        start.set_start()
+        q.push_back(start)
+        for i in range(3):
+            dp = InfluxDataPoint("77", i)
+            dp.create_data_point(float(i), "coverage")
+            q.push_back(dp)
+        end = InfluxDataPoint()
+        end.set_last_datapoint()
+        q.push_back(end)
+
+        t = InfluxThread.spawn(f"http://127.0.0.1:{port}", "user", "pass",
+                               "testdb", q)
+        t.join(timeout=15)
+        assert not t.is_alive(), "reporter thread failed to drain and exit"
+        assert len(_CapturingHandler.received) == 3
+        # POSTs land from per-point sender threads; order is not guaranteed
+        bodies = sorted(b for _, b, _ in _CapturingHandler.received)
+        assert all(p == "/write?db=testdb"
+                   for p, _, _ in _CapturingHandler.received)
+        assert bodies[0].startswith(
+            "coverage,simulation_iter=0,start_time=77 ")
+        assert all(a.startswith("Basic ")
+                   for _, _, a in _CapturingHandler.received)
+    finally:
+        server.shutdown()
+
+
+def test_reporter_thread_survives_unreachable_endpoint():
+    q = DatapointQueue()
+    dp = InfluxDataPoint("1", 0)
+    dp.create_data_point(1.0, "coverage")
+    q.push_back(dp)
+    end = InfluxDataPoint()
+    end.set_last_datapoint()
+    q.push_back(end)
+    # port 9 (discard) — connection refused; errors are logged, not raised
+    t = InfluxThread.spawn("http://127.0.0.1:9", "u", "p", "db", q)
+    t.join(timeout=20)
+    assert not t.is_alive()

@@ -1,0 +1,31 @@
+"""write-accounts binary tests (reference: write_accounts_main.rs:62-125)."""
+
+from gossip_sim_tpu.identity import Pubkey, pubkey_new_unique
+from gossip_sim_tpu.ingest import load_accounts_yaml
+from gossip_sim_tpu.write_accounts import build_parser, write_accounts
+
+
+def test_default_flags():
+    args = build_parser().parse_args([])
+    assert args.num_nodes == (1 << 64) - 1  # "all" (write_accounts_main.rs:34)
+    assert not args.zero_stakes
+    assert not args.filter_zero_staked_nodes
+
+
+def test_write_and_reload_roundtrip(tmp_path):
+    accounts = {pubkey_new_unique(): s for s in (10, 0, 30, 0, 50)}
+    path = str(tmp_path / "accounts.yaml")
+    selected = write_accounts(accounts, 3, path, zero_stakes_only=False)
+    assert len(selected) == 3
+    reloaded = load_accounts_yaml(path)
+    assert {pk.to_string(): s for pk, s in reloaded.items()} == \
+        {pk.to_string(): s for pk, s in selected.items()}
+    assert all(isinstance(pk, Pubkey) for pk in reloaded)
+
+
+def test_zero_stakes_only(tmp_path):
+    accounts = {pubkey_new_unique(): s for s in (10, 0, 30, 0, 50)}
+    path = str(tmp_path / "zero.yaml")
+    selected = write_accounts(accounts, 10, path, zero_stakes_only=True)
+    assert len(selected) == 2
+    assert all(s == 0 for s in selected.values())
