@@ -1,0 +1,123 @@
+"""Primitive microbenchmarks with in-jit repetition (axon tunnel has ~70ms
+round-trip latency, so single-shot timing is meaningless).  Not shipped."""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+REPS = 20
+
+
+def bench(name, make_fn, *args):
+    """make_fn(x, i) -> array; we scan it REPS times with i varying and a
+    data dependency threaded through a scalar to defeat CSE/hoisting."""
+    try:
+        @partial(jax.jit, static_argnums=(1,))
+        def run(args, k):
+            def body(c, i):
+                out = jnp.ravel(make_fn(*args, i + c))
+                # dynamic index defeats XLA's slice-through-op simplifications
+                pos = ((i * 1297 + c) % out.shape[0]).astype(jnp.int32)
+                return lax.dynamic_index_in_dim(
+                    out, pos, keepdims=False).astype(jnp.int32), None
+            c, _ = lax.scan(body, jnp.int32(0), jnp.arange(k))
+            return c
+        int(run(args, 1)); int(run(args, REPS + 1))
+        t1 = min(time.time() * 0 + _t(run, args, 1) for _ in range(2))
+        t2 = min(_t(run, args, REPS + 1) for _ in range(2))
+        dt = (t2 - t1) / REPS
+        print(f"{name:46s} {dt*1e3:9.3f} ms")
+    except Exception as e:
+        print(f"{name:46s} FAILED: {type(e).__name__} {str(e)[:90]}")
+
+
+def _t(run, args, k):
+    t0 = time.time()
+    int(run(args, k))
+    return time.time() - t0
+
+
+def suite(O, N, S=12, D=64):
+    print(f"=== O={O} N={N} S={S} D={D}")
+    rng = np.random.default_rng(0)
+    tgt = jnp.asarray(rng.integers(0, N, (O, N, S)), dtype=jnp.int32)
+    dist = jnp.asarray(rng.integers(0, 15, (O, N)), dtype=jnp.int32)
+    inb = jnp.asarray(rng.integers(0, N, (O, N, D)), dtype=jnp.int32)
+    o3 = jnp.arange(O)[:, None, None]
+    key1 = tgt.reshape(O, N * S)
+    key2 = jnp.asarray(rng.integers(0, 1 << 30, (O, N * S)), dtype=jnp.int32)
+    keys_i32 = jnp.asarray(rng.integers(0, 1 << 30, (O, N, 50)), jnp.int32)
+
+    bench("scatter_min [O,N,S]->[O,N]",
+          lambda t, d, i: d.at[o3, jnp.minimum(t + i, N)].min(
+              jnp.broadcast_to(d[:, :, None] + 1, t.shape), mode="drop"),
+          tgt, dist)
+    bench("scatter_add [O,N,S]->[O,N]",
+          lambda t, i: jnp.zeros((O, N), jnp.int32).at[
+              o3, jnp.minimum(t + i, N)].add(1, mode="drop"), tgt)
+    bench("gather+min [O,N,D]",
+          lambda d, ix, i: jnp.min(
+              (d + i)[jnp.arange(O)[:, None, None], ix], axis=-1),
+          dist, inb)
+    bench("gather [O,NS] flat",
+          lambda d, t, i: (d + i).reshape(O, N)[
+              jnp.arange(O)[:, None], jnp.minimum(t.reshape(O, N * S), N - 1)],
+          dist, tgt)
+    bench("sort 1key i32 [O,NS]",
+          lambda a, i: lax.sort(((a + i) % (1 << 30),), dimension=-1,
+                                num_keys=1)[0], key1)
+    bench("sort 2key i32 [O,NS]",
+          lambda a, b, i: lax.sort((a + i, b), dimension=-1, num_keys=2)[0],
+          key1, key2)
+    bench("sort rows 1key [O,N,50]",
+          lambda a, i: lax.sort((a + i,), dimension=-1, num_keys=1)[0],
+          keys_i32)
+    bench("sort rows 3key [O,N,50]",
+          lambda a, i: lax.sort((a + i, a, a), dimension=-1, num_keys=3)[2],
+          keys_i32)
+    bench("cummax [O,NS]",
+          lambda a, i: lax.cummax(a + i, axis=1), key2)
+    bench("assoc_scan min [O,NS]",
+          lambda a, i: lax.associative_scan(jnp.minimum, a + i, axis=1), key2)
+    bench("top_k 12 [O,N,50]",
+          lambda a, i: lax.top_k(a + i, 12)[0], keys_i32)
+    bench("binsearch50 [O,N,S] into [O,N,50]",
+          lambda q, s, i: _bsearch(s, jnp.minimum(q + i, N)), tgt, keys_i32)
+    if N <= 4096:
+        A = jnp.asarray(rng.random((O, N, N)) < (S / N), dtype=jnp.bfloat16)
+        f8 = jnp.asarray(rng.random((O, 8, N)), dtype=jnp.bfloat16)
+        bench("bf16 [O,8,N]@[O,N,N]",
+              lambda f, A, i: jnp.matmul(f + i.astype(jnp.bfloat16), A),
+              f8, A)
+    M = jnp.ones((4096, 4096), jnp.bfloat16)
+    bench("bf16 4096^3 matmul",
+          lambda m, i: (m + i.astype(jnp.bfloat16)) @ m, M)
+    bench("elementwise x*2+1 [O,N,50]",
+          lambda a, i: (a + i) * 2 + 1, keys_i32)
+
+
+def _bsearch(sorted_rows, queries):
+    import math
+    C = sorted_rows.shape[-1]
+    lo = jnp.zeros(queries.shape, jnp.int32)
+    hi = jnp.full(queries.shape, C, jnp.int32)
+    for _ in range(max(1, math.ceil(math.log2(C))) + 1):
+        act = lo < hi
+        mid = (lo + hi) // 2
+        vals = jnp.take_along_axis(sorted_rows, jnp.minimum(mid, C - 1),
+                                   axis=-1)
+        less = vals < queries
+        lo = jnp.where(act & less, mid + 1, lo)
+        hi = jnp.where(act & ~less, mid, hi)
+    return lo
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "big":
+        suite(32, 10000)
+    else:
+        suite(8, 2000)

@@ -1,0 +1,122 @@
+"""Second-round microbenchmarks: the exact primitives of the redesigned round.
+Differential in-jit repetition (axon round-trip ~70ms). Not shipped."""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+REPS = 20
+
+
+def bench(name, make_fn, *args):
+    try:
+        @partial(jax.jit, static_argnums=(1,))
+        def run(args, k):
+            def body(c, i):
+                out = jnp.ravel(make_fn(*args, i + c))
+                pos = ((i * 1297 + c) % out.shape[0]).astype(jnp.int32)
+                return lax.dynamic_index_in_dim(
+                    out, pos, keepdims=False).astype(jnp.int32), None
+            c, _ = lax.scan(body, jnp.int32(0), jnp.arange(k))
+            return c
+        int(run(args, 1)); int(run(args, REPS + 1))
+        t1 = min(_t(run, args, 1) for _ in range(2))
+        t2 = min(_t(run, args, REPS + 1) for _ in range(2))
+        print(f"{name:52s} {(t2-t1)/REPS*1e3:9.3f} ms")
+    except Exception as e:
+        print(f"{name:52s} FAILED: {type(e).__name__} {str(e)[:80]}")
+
+
+def _t(run, args, k):
+    t0 = time.time()
+    int(run(args, k))
+    return time.time() - t0
+
+
+def suite(O, N, S=12, C=64, K=16, H=64):
+    print(f"=== O={O} N={N} S={S} C={C} K={K}")
+    rng = np.random.default_rng(0)
+    NS = N * S
+    NK = N * K
+    tgt = jnp.asarray(rng.integers(0, N, (O, N, S)), dtype=jnp.int32)
+    dist = jnp.asarray(rng.integers(0, 15, (O, N)), dtype=jnp.int32)
+    idxK = jnp.asarray(rng.integers(0, N, (O, N, K)), dtype=jnp.int32)
+    table = jnp.asarray(rng.integers(0, 1 << 30, (N + 1,)), dtype=jnp.int32)
+    o3 = jnp.arange(O)[:, None, None]
+    flatNK = jnp.asarray(rng.integers(0, N * K, (O, NK)), dtype=jnp.int32)
+    valsNK = jnp.asarray(rng.integers(0, 1 << 30, (O, NK)), dtype=jnp.int32)
+    key1 = jnp.sort(tgt.reshape(O, NS), axis=-1)
+    key2 = jnp.asarray(rng.integers(0, 1 << 30, (O, NS)), dtype=jnp.int32)
+    rows62 = jnp.asarray(rng.integers(0, 1 << 30, (O, N, C + K)), jnp.int32)
+    startpos = jnp.asarray(
+        np.sort(rng.integers(0, NS + N, (O, N)), axis=-1), jnp.int32)
+
+    bench("gather [O,N,K] from [N+1] table",
+          lambda ix, t, i: (t + i)[ix], idxK, table)
+    bench("gather [O,N] from [O,NS+N] (BFS extract)",
+          lambda sp, v, i: jnp.take_along_axis(
+              jnp.concatenate([v + i, v[:, :N]], axis=1), sp, axis=1),
+          startpos, key2)
+    bench("scatter [O,NK]->[O,N,K] i32",
+          lambda f, v, i: jnp.zeros((O, N * K), jnp.int32).at[
+              jnp.arange(O)[:, None], f].set(v + i, mode="drop"),
+          flatNK, valsNK)
+    bench("sort [O,NS] 2key+2payload",
+          lambda a, b, i: lax.sort((a, b + i, b, b), dimension=-1,
+                                   num_keys=2)[2], key1, key2)
+    bench("sort [O,NS] 1key+1payload",
+          lambda a, b, i: lax.sort((a + i, b), dimension=-1, num_keys=1)[1],
+          key1, key2)
+    bench("row sort [O,N,C+K] 1key+2payload",
+          lambda r, i: lax.sort((r + i, r, r), dimension=-1, num_keys=1)[1],
+          rows62)
+    bench("row sort [O,N,C+K] 4key",
+          lambda r, i: lax.sort((r + i, r, r, r), dimension=-1, num_keys=4)[3],
+          rows62)
+    bench("seg log-shift min [O,NS]",
+          lambda k1, v, i: _seg_min(k1, v + i), key1, key2)
+    bench("onehot hist [O,N]->[O,H]",
+          lambda d, i: jnp.sum(
+              ((d + i) % H)[:, :, None] == jnp.arange(H)[None, None, :],
+              axis=1, dtype=jnp.int32), dist)
+    bench("cumsum i64-as-2xi32 rows [O,N,C]",
+          lambda r, i: _cumsum64(r[..., :C] + i, r[..., :C]), rows62)
+    bench("while10 x elementwise [O,NS]",
+          lambda v, i: lax.while_loop(
+              lambda c: c[1] < 10,
+              lambda c: (jnp.minimum(c[0], c[0] * 3 + i), c[1] + 1),
+              (v, jnp.int32(0)))[0], key2)
+
+
+def _seg_min(sorted_keys, vals):
+    O, M = vals.shape
+    is_start = jnp.concatenate(
+        [jnp.ones((O, 1), bool),
+         sorted_keys[:, 1:] != sorted_keys[:, :-1]], axis=1)
+    x = vals
+    blocked = is_start
+    sh = 1
+    while sh < M:
+        prev = jnp.pad(x, ((0, 0), (sh, 0)), constant_values=1 << 30)[:, :M]
+        pb = jnp.pad(blocked, ((0, 0), (sh, 0)), constant_values=True)[:, :M]
+        x = jnp.where(blocked, x, jnp.minimum(x, prev))
+        blocked = blocked | pb
+        sh *= 2
+    return x
+
+
+def _cumsum64(hi, lo):
+    chi = jnp.cumsum(hi, axis=-1)
+    clo = jnp.cumsum(lo, axis=-1)
+    return chi + (clo >> 16)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "big":
+        suite(32, 10000)
+    else:
+        suite(8, 2000)

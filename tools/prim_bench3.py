@@ -1,0 +1,91 @@
+"""Third-round microbenchmarks: block gathers + compacted-F hop ops."""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+REPS = 20
+
+
+def bench(name, make_fn, *args):
+    try:
+        @partial(jax.jit, static_argnums=(1,))
+        def run(args, k):
+            def body(c, i):
+                out = jnp.ravel(make_fn(*args, i + c))
+                pos = ((i * 1297 + c) % out.shape[0]).astype(jnp.int32)
+                return lax.dynamic_index_in_dim(
+                    out, pos, keepdims=False).astype(jnp.int32), None
+            c, _ = lax.scan(body, jnp.int32(0), jnp.arange(k))
+            return c
+        int(run(args, 1)); int(run(args, REPS + 1))
+        t1 = min(_t(run, args, 1) for _ in range(2))
+        t2 = min(_t(run, args, REPS + 1) for _ in range(2))
+        print(f"{name:52s} {(t2-t1)/REPS*1e3:9.3f} ms")
+    except Exception as e:
+        print(f"{name:52s} FAILED: {type(e).__name__} {str(e)[:80]}")
+
+
+def _t(run, args, k):
+    t0 = time.time()
+    int(run(args, k))
+    return time.time() - t0
+
+
+def suite(O, N, F=6, K=16):
+    print(f"=== O={O} N={N} F={F} K={K}")
+    rng = np.random.default_rng(0)
+    NF = N * F
+    M = NF + N
+    vals = jnp.asarray(rng.integers(0, 1 << 30, (O, M + K)), jnp.int32)
+    startpos = jnp.asarray(
+        np.sort(rng.integers(0, M, (O, N)), axis=-1), jnp.int32)
+    keyNF = jnp.sort(jnp.asarray(
+        rng.integers(0, 2 * N, (O, NF)), jnp.int32), axis=-1)
+
+    # block gather: windows [startpos, startpos+K) from [O, M+K]
+    def block_gather(sp, v, i):
+        idx = sp[:, :, None] + jnp.arange(K)[None, None, :]
+        return jnp.take_along_axis(
+            (v + i)[:, :, None], jnp.minimum(idx, M + K - 1).reshape(
+                O, N * K)[:, :, None], axis=1)
+    bench("block gather [O,N,K] windows from [O,M]",
+          lambda sp, v, i: jnp.take_along_axis(
+              v + i, jnp.minimum(
+                  sp[:, :, None] + jnp.arange(K)[None, None, :],
+                  M + K - 1).reshape(O, N * K), axis=1),
+          startpos, vals)
+    bench("block gather [O,N,4] windows",
+          lambda sp, v, i: jnp.take_along_axis(
+              v + i, jnp.minimum(
+                  sp[:, :, None] + jnp.arange(4)[None, None, :],
+                  M + K - 1).reshape(O, N * 4), axis=1),
+          startpos, vals)
+    bench("random gather [O,N] from [O,M]",
+          lambda sp, v, i: jnp.take_along_axis(v + i, sp, axis=1),
+          startpos, vals)
+    bench("sort [O,NF] 1key i32",
+          lambda a, i: lax.sort(((a + i) % (1 << 29),), dimension=-1,
+                                num_keys=1)[0], keyNF)
+    bench("sort [O,NF] 1key+1payload",
+          lambda a, i: lax.sort((a + i, a), dimension=-1, num_keys=1)[1],
+          keyNF)
+    bench("sort [O,NF+N] 1key+1payload",
+          lambda v, i: lax.sort((v[:, :M] + i, v[:, :M]), dimension=-1,
+                                num_keys=1)[1], vals)
+    bench("row sort+slice [O,N,12]->[O,N,6]",
+          lambda a, i: lax.sort(
+              ((a + i).reshape(O, N, 12), a.reshape(O, N, 12)),
+              dimension=-1, num_keys=1)[1][..., :6],
+          vals[:, :N * 12])
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "big":
+        suite(32, 10000)
+    else:
+        suite(8, 2000)

@@ -1,0 +1,118 @@
+"""Ad-hoc stage profiler for round_step on the real chip (not shipped)."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                   make_cluster_tables, run_rounds)
+from gossip_sim_tpu.engine.core import INF, _row_searchsorted
+
+N, O = 2000, 8
+
+
+def bench(name, fn, *args):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.time()
+    for _ in range(10):
+        out = jax.block_until_ready(fn(*args))
+    dt = (time.time() - t0) / 10
+    print(f"{name:28s} {dt*1e3:9.2f} ms")
+    return out
+
+
+rng = np.random.default_rng(0)
+stakes = (np.exp(rng.normal(9.5, 2.0, N)).astype(np.int64) + 1) * 10**9
+tables = make_cluster_tables(stakes)
+params = EngineParams(num_nodes=N, warm_up_rounds=0)
+origins = jnp.arange(O, dtype=jnp.int32)
+state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+state = jax.block_until_ready(state)
+p = params
+S, F, C, K = p.active_set_size, p.push_fanout, p.rc_slots, p.inbound_cap
+
+o1 = jnp.arange(O)
+o2 = o1[:, None]
+o3 = o1[:, None, None]
+tgt = jnp.where(state.active < N, state.active, N)
+
+
+@jax.jit
+def full_round(st):
+    from gossip_sim_tpu.engine import round_step
+    return round_step(params, tables, origins, st, jnp.int32(5))
+
+
+@jax.jit
+def relax_loop(tgt):
+    dist0 = jnp.full((O, N), INF, jnp.int32).at[o1, origins].set(0)
+
+    def relax(carry):
+        dist, _ = carry
+        cand = jnp.where(dist < INF, dist + 1, INF)[:, :, None]
+        cand = jnp.broadcast_to(cand, tgt.shape)
+        new = dist.at[o3, tgt].min(cand, mode="drop")
+        return new, jnp.any(new != dist)
+
+    dist, _ = lax.while_loop(lambda c: c[1], relax, (dist0, jnp.bool_(True)))
+    return dist
+
+
+@jax.jit
+def verb2_sort(tgt, dist):
+    n_idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+    hop1 = jnp.minimum(dist + 1, 64 - 1)
+    key1 = tgt.reshape(O, N * S)
+    key2 = (hop1[:, :, None] * N + n_idx[:, :, None]).astype(jnp.int32)
+    key2 = jnp.broadcast_to(key2, (O, N, S)).reshape(O, N * S)
+    tgt_s, key2_s = lax.sort((key1, key2), dimension=-1, num_keys=2)
+    return tgt_s, key2_s
+
+
+@jax.jit
+def rc_merge(tgt_s, key2_s):
+    src_s = key2_s % N
+    eidx = jnp.arange(N * S, dtype=jnp.int32)[None, :]
+    is_start = jnp.concatenate(
+        [jnp.ones((O, 1), bool), tgt_s[:, 1:] != tgt_s[:, :-1]], axis=1)
+    seg_start = lax.cummax(jnp.where(is_start, eidx, 0), axis=1)
+    rank = eidx - seg_start
+    inb = jnp.full((O, N, K), N, jnp.int32).at[
+        o2, tgt_s, rank].set(src_s, mode="drop")
+    rc_src, rc_score = state.rc_src, state.rc_score
+    pos = _row_searchsorted(rc_src, inb)
+    return inb, pos
+
+
+@jax.jit
+def prune_sort(rc_src, rc_score):
+    member = rc_src < N
+    m_stake = tables.stakes[rc_src]
+    neg_score = jnp.where(member, -rc_score, jnp.iinfo(jnp.int32).max)
+    neg_stake = jnp.where(member, -m_stake, jnp.iinfo(jnp.int64).max)
+    _, _, src_sorted = lax.sort(
+        (neg_score, neg_stake, rc_src), dimension=-1, num_keys=3)
+    return src_sorted
+
+
+@jax.jit
+def prune_sort_i32(rc_src, rc_score):
+    member = rc_src < N
+    m_stake = tables.stakes[rc_src]
+    # rank stakes as i32 surrogate
+    neg_score = jnp.where(member, -rc_score, jnp.iinfo(jnp.int32).max)
+    neg_stake = jnp.where(member, -(m_stake >> 20).astype(jnp.int32),
+                          jnp.iinfo(jnp.int32).max)
+    _, _, src_sorted = lax.sort(
+        (neg_score, neg_stake, rc_src), dimension=-1, num_keys=3)
+    return src_sorted
+
+
+st1, rows = bench("full_round", full_round, state)
+dist = bench("relax_loop", relax_loop, tgt)
+tgt_s, key2_s = bench("verb2_sort", verb2_sort, tgt, dist)
+bench("rc_merge(partial)", rc_merge, tgt_s, key2_s)
+bench("prune_sort(i64keys)", prune_sort, state.rc_src, state.rc_score)
+bench("prune_sort(i32keys)", prune_sort_i32, state.rc_src, state.rc_score)

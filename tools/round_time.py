@@ -1,0 +1,50 @@
+"""Trustworthy per-round compute timing via differential scan lengths."""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                   make_cluster_tables)
+from gossip_sim_tpu.engine.core import round_step
+from jax import lax
+from functools import partial
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
+O = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+rng = np.random.default_rng(0)
+stakes = (np.exp(rng.normal(9.5, 2.0, N)).astype(np.int64) + 1) * 10**9
+tables = make_cluster_tables(stakes)
+params = EngineParams(num_nodes=N, warm_up_rounds=0)
+origins = jnp.arange(O, dtype=jnp.int32)
+state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def run_k(state, k):
+    def step(st, it):
+        st2, rows = round_step(params, tables, origins, st, it)
+        return st2, None
+    st, _ = lax.scan(step, state, jnp.arange(k))
+    return st.rc_upserts[0, 0] + st.active[0, 0, 0]
+
+
+def timed(k, reps=3):
+    int(run_k(state, k))  # compile
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.time()
+        int(run_k(state, k))
+        best = min(best, time.time() - t0)
+    return best
+
+
+t1 = timed(1)
+t21 = timed(21)
+per_round = (t21 - t1) / 20
+print(f"N={N} O={O}: 1-round call {t1*1e3:.1f} ms, 21-round call "
+      f"{t21*1e3:.1f} ms -> per-round {per_round*1e3:.2f} ms, "
+      f"{O/per_round:.1f} origin-iters/s")
