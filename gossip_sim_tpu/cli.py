@@ -157,6 +157,8 @@ def find_nth_largest_node(n, items):
     nth largest stake value (duplicates counted separately).
     """
     import heapq
+    if n <= 0:
+        return None
     heap = []
     for _, stake in items:
         if len(heap) < n:
@@ -206,6 +208,10 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
     (gossip_main.rs:425-565)."""
     from .oracle.cluster import Cluster, Node
 
+    if config.checkpoint_path:
+        log.warning("WARNING: --checkpoint-path is supported by the tpu "
+                    "backend only; the oracle backend will not write %s",
+                    config.checkpoint_path)
     rng = ChaChaRng.from_seed_byte(config.seed % 256)
     stakes = dict(accounts)
     nodes = [Node(pk, stake) for pk, stake in accounts.items()]
@@ -608,6 +614,10 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     config = config_from_args(args)
     origin_ranks = args.origin_rank
+    if any(r < 1 for r in origin_ranks):
+        log.error("ERROR: --origin-rank values must be >= 1 (1 = highest "
+                  "stake), got: %s", origin_ranks)
+        return 1
 
     # origin-rank count validation (gossip_main.rs:706-716)
     if len(origin_ranks) < config.num_simulations:
@@ -657,7 +667,17 @@ def main(argv=None) -> int:
         if config.backend != "tpu":
             log.error("--all-origins requires --backend tpu")
             return 1
+        if dp_queue is not None:
+            log.warning("WARNING: --all-origins reports aggregates only; "
+                        "per-iteration Influx series are not emitted in "
+                        "this mode")
         run_all_origins(config, args.json_rpc_url)
+        if dp_queue is not None:
+            dp = InfluxDataPoint()
+            dp.set_last_datapoint()
+            dp_queue.push_back(dp)
+            if influx_thread is not None:
+                influx_thread.join()
         return 0
 
     collection = GossipStatsCollection()

@@ -1,66 +1,50 @@
-"""The five-verb gossip round as batched dense-array kernels.
+"""The five-verb gossip round as sort-routed dense-array kernels (v2).
 
-One ``SimState`` holds ``O`` independent single-origin simulations over an
-``N``-node cluster (the reference simulates exactly one origin per run,
-gossip_main.rs:292-647; batching origins is the north-star parallelization,
-SURVEY.md §2.3).  Per round, matching gossip_main.rs:449-473:
+Same semantics and state layout as the original engine (see the docstring
+history in git), re-architected around the TPU's primitive cost profile as
+measured on-chip (tools/prim_bench*.py):
 
-  1. push/diffuse  — fanout-target selection + frontier relaxation
-                     (replaces the sequential BFS, gossip.rs:494-615)
-  2. consume       — rank inbound peers by (hop, node index) and merge into
-                     the received cache (gossip.rs:618-653,
-                     received_cache.rs:83-98)
-  3. prune decide  — upsert-gated (score, stake) ranking + stake-threshold
-                     prefix rule (received_cache.rs:38-63,100-131)
-  4. prune apply   — set per-slot pruned bits in the senders' active entries
-                     (push_active_set.rs:56-71,143-151)
-  5. rotate        — Bernoulli(p) incremental rotation: swap one weighted
-                     sample in, evict the oldest slot (gossip.rs:739-754,
-                     push_active_set.rs:153-186)
+  * ``lax.sort`` moves data at ~1.4 ns/element (row-local sorts ~0.15),
+  * gathers and scatters cost ~7-11 ns/element and serialize,
+  * elementwise/VPU work is effectively free at these shapes.
 
-Key origin-reduction insight: stakes are static, so for a fixed origin ``o``
-every node ``s`` reads/writes exactly ONE active-set entry — bucket
-``min(bucket(s), bucket(o))`` (push_active_set.rs:48,68; bucketing is
-monotone in stake, so bucket(min) == min(bucket)).  Each of the O sims
-therefore tracks a single [N, S] active-set slice instead of [N, 25, S],
-and the 25-bucket structure survives only in the rotation weights.
+Every cross-node data movement is therefore expressed as a *sort*:
 
-Documented divergences from the reference (all distribution-level, none
-affecting the semantics downstream of sampling):
+  * BFS frontier propagation (gossip.rs:494-615): per hop, edges carry a
+    "source is on the frontier" bit to their targets via a 1-key sort of
+    ``target*2 + (1-bit)``; with one pseudo-edge appended per target, the
+    run-start entries are exactly one per target, and a second 1-key sort
+    (run-starts first) compacts them into a dense ``[O, N]`` frontier —
+    no scatter, no gather.
+  * Inbound ranking (gossip.rs:618-653): one 2-key sort by
+    ``(target, hop<<14 | src)`` ranks every delivered edge; the same
+    pseudo-edge trick compacts per-target inbound lists ``[O, N, K]`` and
+    ingress counts without a scatter.
+  * Received-cache merge (received_cache.rs:83-98): row-local sorts over
+    ``C+K``-wide rows implement member lookup, score bumps, capacity-gated
+    insertion and eviction.
+  * Prune application (push_active_set.rs:56-71): pruner/prunee pairs and
+    active-set edges meet in one shared sort keyed by
+    ``peer*16384 + owner``; a budgeted fast path handles the common
+    few-prunes case and a ``lax.cond`` falls back to the full-width sort
+    when a row prunes more than ``pa_slots`` peers at once.
+  * Weighted sampling (push_active_set.rs:96-111): the stake-class CDF is
+    selected per (origin, node) with an elementwise ``min(bucket)`` trick
+    (no per-node CDF gather), and the class->node-id translation runs
+    through a sort-join instead of a table gather.
 
-  * WeightedShuffle -> stake-class categorical sampling (see sampler.py);
-    parity is distributional (selection probability ∝ weight).
-  * The per-peer pruned-origin Bloom filter (0.1 false-positive rate,
-    push_active_set.rs:122-123) is an exact per-slot bit: the engine never
-    over-prunes from bloom false positives.  The self-seeded entry
-    (push_active_set.rs:179) is the exact ``peer != origin`` mask.
-  * Inbound peers per (dest, round) are ranked exactly but only the first
-    ``inbound_cap`` ranks are recorded (reference records all); ranks >= 2
-    only reserve score-0 slots, so the tail is statistics-neutral in
-    realistic regimes.  Dropped edges are counted in ``rows["inb_dropped"]``.
-  * The received-cache entry is ``rc_slots`` physical slots; the reference's
-    50-entry *insert cap* (received_cache.rs:78) is enforced exactly, but a
-    pathological mix of unconditional scored inserts could exceed the
-    physical slots; overflow evicts the largest node ids and is counted in
-    ``rows["rc_overflow"]``.
-  * On exact (score, stake) prune ties the reference's unstable sort is
-    nondeterministic; the engine tie-breaks by node index ascending (the
-    CPU oracle tie-breaks by pubkey bytes — craft distinct stakes in parity
-    tests).
-  * Per-thread entropy RNG (gossip.rs:747-753) is replaced by
-    ``fold_in(key, origin)``/``fold_in(key, round)`` counter-based streams:
-    deterministic by construction and independent of origin-batch chunking.
-  * Initialization samples active-set peers with replacement and keeps the
-    first S distinct (``init_draws`` tries); under extreme stake skew an
-    entry can start underfilled where the reference's WeightedShuffle always
-    fills to size.  Underfilled slots hold the sentinel ``N`` (never pushed
-    to) and are topped up by rotation events over time; callers can audit
-    fill via ``(state.active == N).sum()``.
+Node failure (gossip.rs:756-771) is tracked per active-set slot
+(``tfail``) and maintained incrementally at rotation/failure events so the
+hot path never gathers ``failed[peer]``.
+
+Documented divergences from the reference are unchanged from v1 (see
+git history of this module): distributional sampling parity, exact prune
+bits instead of 0.1-fp blooms, ``inbound_cap`` ranking, ``rc_slots``
+physical slots, index tie-breaks, counter-based RNG streams.
 """
 
 from __future__ import annotations
 
-import math
 from functools import partial
 from typing import NamedTuple
 
@@ -71,9 +55,11 @@ from jax import lax
 
 from ..identity import stake_buckets_array
 from .params import EngineParams
-from .sampler import SamplerTables, build_sampler_tables, sample_peers
+from .sampler import SamplerTables, build_sampler_tables
 
-INF = jnp.int32(1 << 20)  # unreached sentinel (maps to u64::MAX, gossip.rs:490)
+INF = jnp.int32(1 << 20)   # unreached sentinel (maps to u64::MAX, gossip.rs:490)
+BIG = jnp.int32(0x7FFFFFFF)
+PACK = 16384               # node-id packing base; requires num_nodes < 16384
 
 
 class ClusterTables(NamedTuple):
@@ -82,6 +68,8 @@ class ClusterTables(NamedTuple):
     stakes: jax.Array    # [N + 1] i64 lamports; index N is a 0 pad (sentinel)
     buckets: jax.Array   # [N] i32 log2 stake buckets (push_active_set.rs:190-196)
     sampler: SamplerTables
+    shi: jax.Array       # [N + 1] i32 stake >> 31 (sort-key split)
+    slo: jax.Array       # [N + 1] i32 stake & 0x7fffffff
 
 
 class SimState(NamedTuple):
@@ -90,8 +78,11 @@ class SimState(NamedTuple):
     key: jax.Array          # [O, 2] u32 per-origin PRNG key
     active: jax.Array       # [O, N, S] i32 peer per slot, oldest->newest; N = empty
     pruned: jax.Array       # [O, N, S] bool peer-has-pruned-this-origin bit
+    tfail: jax.Array        # [O, N, S] bool peer-is-failed bit (== failed[peer])
     rc_src: jax.Array       # [O, N, C] i32 received-cache peers, sorted asc; N = empty
     rc_score: jax.Array     # [O, N, C] i32 per-peer scores (received_cache.rs:83-98)
+    rc_shi: jax.Array       # [O, N, C] i32 member stake >> 31
+    rc_slo: jax.Array       # [O, N, C] i32 member stake & 0x7fffffff
     rc_upserts: jax.Array   # [O, N] i32 upsert counter (received_cache.rs:13-21)
     failed: jax.Array       # [O, N] bool fault-injection mask (gossip.rs:756-771)
     egress_acc: jax.Array   # [O, N] i32 measured-round egress message counts
@@ -104,43 +95,114 @@ class SimState(NamedTuple):
 def make_cluster_tables(stakes_lamports: np.ndarray) -> ClusterTables:
     """Build static device tables from the per-node stake vector."""
     stakes = np.asarray(stakes_lamports, dtype=np.int64)
+    assert stakes.shape[0] < PACK, (
+        f"engine packs node ids into 14 bits; num_nodes must be < {PACK}")
+    assert (stakes >= 0).all() and (stakes < (1 << 62)).all()
     buckets = stake_buckets_array(stakes.astype(np.uint64)).astype(np.int32)
+    padded = np.concatenate([stakes, [0]])
     return ClusterTables(
-        stakes=jnp.asarray(np.concatenate([stakes, [0]])),
+        stakes=jnp.asarray(padded),
         buckets=jnp.asarray(buckets),
         sampler=build_sampler_tables(buckets),
+        shi=jnp.asarray((padded >> 31).astype(np.int32)),
+        slo=jnp.asarray((padded & 0x7FFFFFFF).astype(np.int32)),
     )
 
 
 # --------------------------------------------------------------------------
-# small vector utilities
+# sort-routing utilities
 # --------------------------------------------------------------------------
 
-def _row_searchsorted(sorted_rows: jax.Array, queries: jax.Array) -> jax.Array:
-    """Left-bisect each query into its row of ``sorted_rows``.
+def _boundary(keys: jax.Array) -> jax.Array:
+    """[O, M] -> mask of positions where a new key-run begins."""
+    O = keys.shape[0]
+    return jnp.concatenate(
+        [jnp.ones((O, 1), bool), keys[:, 1:] != keys[:, :-1]], axis=1)
 
-    sorted_rows [..., C] ascending; queries [..., K] -> positions [..., K].
-    Fixed-trip binary search (log2(C) gathers) — avoids the O(K*C)
-    broadcast-compare blowup at production shapes.
+
+def _rank_in_run(run_of: jax.Array) -> jax.Array:
+    """Position of each element within its (sorted, contiguous) run."""
+    O, M = run_of.shape
+    iot = jnp.broadcast_to(jnp.arange(M, dtype=jnp.int32)[None, :], (O, M))
+    start = lax.cummax(jnp.where(_boundary(run_of), iot, 0), axis=1)
+    return iot - start
+
+
+def _lookup(table_vals: jax.Array, queries: jax.Array, n: int) -> jax.Array:
+    """Sort-join table lookup: ``table_vals[queries]`` without a gather.
+
+    table_vals: [O, n] i32 per-origin table; queries: [O, M] i32 in [0, n).
+    Entries and queries meet in one sort keyed by value; each value-run is
+    headed by its (unique, always-present) table entry, whose payload is
+    forward-filled through the run and routed back by original position.
     """
-    C = sorted_rows.shape[-1]
-    lo = jnp.zeros(queries.shape, jnp.int32)
-    hi = jnp.full(queries.shape, C, jnp.int32)
-    for _ in range(max(1, math.ceil(math.log2(C))) + 1):
-        active = lo < hi
-        mid = (lo + hi) // 2
-        vals = jnp.take_along_axis(sorted_rows, jnp.minimum(mid, C - 1), axis=-1)
-        less = vals < queries
-        lo = jnp.where(active & less, mid + 1, lo)
-        hi = jnp.where(active & ~less, mid, hi)
-    return lo
+    O, M = queries.shape
+    W = n + M
+    iota_n = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[None, :], (O, n))
+    keys = jnp.concatenate(
+        [iota_n * 2, queries * 2 + 1], axis=1)                   # [O, n+M]
+    vals = jnp.concatenate(
+        [jnp.broadcast_to(table_vals, (O, n)),
+         jnp.zeros((O, M), table_vals.dtype)], axis=1)
+    pos = jnp.concatenate(
+        [jnp.full((O, n), BIG), jnp.broadcast_to(
+            jnp.arange(M, dtype=jnp.int32)[None, :], (O, M))], axis=1)
+    sk, sv, sp = lax.sort((keys, vals, pos), dimension=-1, num_keys=1)
+    have = (sk & 1) == 0
+    if W * PACK <= (1 << 31):
+        # forward fill via one packed cummax: a query's head is the nearest
+        # table entry to its left (its own value-run always starts with one)
+        iw = jnp.arange(W, dtype=jnp.int32)[None, :]
+        packed = jnp.where(have, iw * PACK + sv.astype(jnp.int32), -1)
+        fill = lax.cummax(packed, axis=1) % PACK
+    else:
+        run = sk >> 1
+        fill = jnp.where(have, sv, 0)
+        sh = 1
+        while sh < W:
+            pk = jnp.pad(run, ((0, 0), (sh, 0)), constant_values=-1)[:, :W]
+            pf = jnp.pad(fill, ((0, 0), (sh, 0)))[:, :W]
+            ph = jnp.pad(have, ((0, 0), (sh, 0)))[:, :W]
+            take = (~have) & ph & (pk == run)
+            fill = jnp.where(take, pf, fill)
+            have = have | take
+            sh *= 2
+    _, out = lax.sort((sp, fill.astype(jnp.int32)), dimension=-1, num_keys=1)
+    return out[:, :M]
 
 
-def _gather_rows(mat: jax.Array, t_idx: jax.Array, pos: jax.Array) -> jax.Array:
-    """mat [O, N, C]; t_idx/pos [O, ...] -> mat[o, t_idx, pos] elementwise."""
-    O = mat.shape[0]
-    o_idx = jnp.arange(O).reshape((O,) + (1,) * (t_idx.ndim - 1))
-    return mat[o_idx, t_idx, pos]
+def _sample_fast(tables: ClusterTables, origins: jax.Array,
+                 u_class: jax.Array, u_member: jax.Array):
+    """Weighted peer draw for entry ``k = min(bucket(n), bucket(o))``.
+
+    u_class/u_member: [O, N, T] f32.  Returns class-member positions
+    [O, N, T] i32 in bucket-sorted space (translate with ``_lookup`` over
+    ``sampler.perm``).  Identical math to sampler.sample_peers, but the CDF
+    row is an elementwise select — ``min(b_n, b_o)`` equals ``b_n`` when
+    ``b_n <= b_o`` (own row, static) and ``b_o`` otherwise (one dynamic row
+    per origin) — so no per-node CDF gather is needed.
+    """
+    s = tables.sampler
+    b = tables.buckets                                   # [N]
+    b_o = tables.buckets[origins]                        # [O]
+    cdf_own = s.cdf_own                                  # [N, NB]
+    cdf_org = s.class_cdf[b_o]                           # [O, NB]
+    own = (b[None, :] <= b_o[:, None])[..., None, None]  # [O, N, 1, 1]
+    cdf = jnp.where(own, cdf_own[None, :, None, :], cdf_org[:, None, None, :])
+    cls = jnp.sum((u_class[..., None] >= cdf[..., :-1]).astype(jnp.int32),
+                  axis=-1)                               # [O, N, T]
+    oh = (cls[..., None] == jnp.arange(s.class_cdf.shape[0])[None, None,
+                                                            None, :])
+    ohf = oh.astype(jnp.float32)
+    start = jnp.einsum("...c,c->...", ohf,
+                       s.class_start.astype(jnp.float32)).astype(jnp.int32)
+    count = jnp.einsum("...c,c->...", ohf,
+                       s.class_count.astype(jnp.float32)).astype(jnp.int32)
+    member = start + jnp.floor(
+        u_member * count.astype(jnp.float32)).astype(jnp.int32)
+    member = jnp.minimum(member, start + jnp.maximum(count - 1, 0))
+    return member
 
 
 # --------------------------------------------------------------------------
@@ -168,15 +230,15 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
     # small integers into the same per-origin key otherwise).
     draw_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(
         okeys, 0x696E6974)
-    b = tables.buckets
-    k_os = jnp.minimum(b[None, :], b[origins][:, None])          # [O, N]
     self_idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+    perm_t = jnp.broadcast_to(tables.sampler.perm[None, :], (O, N))
 
     def draw_step(carry, e):
         buf, cnt = carry                                         # [O,N,S+1], [O,N]
         ek = jax.vmap(jax.random.fold_in, in_axes=(0, None))(draw_keys, e)
         u = jax.vmap(lambda k: jax.random.uniform(k, (N, 2), dtype=jnp.float32))(ek)
-        cand = sample_peers(tables.sampler, k_os, u[..., 0], u[..., 1])
+        member = _sample_fast(tables, origins, u[..., 0:1], u[..., 1:2])
+        cand = _lookup(perm_t, member[..., 0].reshape(O, N), N).reshape(O, N)
         dup = jnp.any(buf == cand[..., None], axis=-1) | (cand == self_idx)
         ins = (~dup) & (cnt <= S)
         slot = jnp.minimum(cnt, S)
@@ -196,8 +258,11 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
         key=okeys,
         active=active,
         pruned=jnp.zeros((O, N, S), bool),
+        tfail=jnp.zeros((O, N, S), bool),
         rc_src=jnp.full((O, N, C), N, jnp.int32),
         rc_score=zi((O, N, C)),
+        rc_shi=zi((O, N, C)),
+        rc_slo=zi((O, N, C)),
         rc_upserts=zi((O, N)),
         failed=jnp.zeros((O, N), bool),
         egress_acc=zi((O, N)),
@@ -214,21 +279,17 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
 
 def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
                state: SimState, it: jax.Array, detail: bool = False):
-    """One full gossip round for all O origin-sims.  Returns (state, rows).
-
-    ``rows`` is a dict of [O]-shaped per-round statistics; with
-    ``detail=True`` it additionally carries the [O, N] stranded mask (for
-    the per-iteration stranded-stake stats, gossip_stats.rs:766-843).
-    """
+    """One full gossip round for all O origin-sims.  Returns (state, rows)."""
     p = params
     N, S, F, C, K, H = (p.num_nodes, p.active_set_size, p.push_fanout,
                         p.rc_slots, p.inbound_cap, p.hist_bins)
+    F = min(F, S)
     O = int(origins.shape[0])
     origins = origins.astype(jnp.int32)
     o1 = jnp.arange(O)
-    o2 = o1[:, None]
-    o3 = o1[:, None, None]
-    n_idx = jnp.arange(N, dtype=jnp.int32)[None, :]
+    origin_col = origins[:, None, None]
+    NF, NK = N * F, N * K
+    iota_n = jnp.arange(N, dtype=jnp.int32)[None, :]
 
     kr = jax.vmap(jax.random.fold_in, in_axes=(0, None))(state.key, it)
     nsub = p.rot_tries + 2
@@ -236,117 +297,188 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
 
     # ---- fault injection (gossip.rs:756-771; fires when it == when_to_fail,
     # gossip_main.rs:449-452) --------------------------------------------
-    failed = state.failed
+    failed, tfail = state.failed, state.tfail
     # truncating, like the reference's `as usize` (gossip.rs:758)
     n_fail = int(p.fail_fraction * N)
     if p.fail_at >= 0 and n_fail > 0:
-        def _fail(f):
+        def _fail(ft):
+            f, _ = ft
             r = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
                 subs[:, 0])
             kth = jnp.sort(r, axis=-1)[:, n_fail - 1][:, None]
-            return f | (r <= kth)
-        failed = lax.cond(it == p.fail_at, _fail, lambda f: f, failed)
+            f = f | (r <= kth)
+            # rebuild per-slot target-failed bits via sort-join (once)
+            q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
+            tf = _lookup(f.astype(jnp.int32), q, N).reshape(O, N, S) == 1
+            return f, tf & (state.active < N)
+        failed, tfail = lax.cond(it == p.fail_at, _fail,
+                                 lambda ft: ft, (failed, tfail))
 
-    # ---- verb 1: push/diffuse (gossip.rs:494-615) -----------------------
+    # ---- verb 1: push targets (gossip.rs:494-615) -----------------------
     peer = state.active
-    origin_col = origins[:, None, None]
     is_peer = peer < N
     # get_nodes filter: bloom-contains(origin) == pruned bit OR peer == origin
     # (self-seeded bloom, push_active_set.rs:128-141,179).
     valid = is_peer & (~state.pruned) & (peer != origin_col)
-    sel = valid & (jnp.cumsum(valid, axis=-1) <= F)   # first F unpruned slots
-    peer_c = jnp.minimum(peer, N - 1)
-    peer_failed = failed[o3, peer_c] & is_peer
-    # Failed targets consume a fanout slot but receive nothing (gossip.rs:538-541).
-    tgt = jnp.where(sel & ~peer_failed, peer, N)                 # [O, N, S]
+    # first F valid slots, failed targets consume a slot but receive nothing
+    # (gossip.rs:538-541): compact (slot-order) then mask failed targets.
+    skey = jnp.where(valid, jnp.arange(S, dtype=jnp.int32)[None, None, :], S)
+    skey_s, peer_sf, tfail_sf = lax.sort(
+        (skey, peer, tfail.astype(jnp.int32)), dimension=-1, num_keys=1)
+    slot_ok = skey_s[..., :F] < S
+    peerF = peer_sf[..., :F]
+    tgt = jnp.where(slot_ok & (tfail_sf[..., :F] == 0), peerF, N)  # [O,N,F]
+    tgtf = tgt.reshape(O, NF)
+    pseudo_t = jnp.broadcast_to(iota_n, (O, N))
 
+    # ---- BFS frontier relaxation: two 1-key sorts per hop ---------------
+    # Hop-1 seed: the origin's own targets are a tiny slice, so the loop
+    # starts at hop 1 and each iteration costs only edge-key perturbation +
+    # two 1-key sorts over the (static) edge/pseudo key base.
+    tgt2_base = jnp.concatenate(
+        [jnp.where(tgt < N, tgt * 2, BIG - 1).reshape(O, NF),
+         pseudo_t * 2 + 1], axis=1)                              # [O, NF+N]
+    org_tgts = tgt[o1[:, None], origins[:, None],
+                   jnp.arange(F)[None, :]]                       # [O, F]
     dist0 = jnp.full((O, N), INF, jnp.int32).at[o1, origins].set(0)
+    dist0 = dist0.at[o1[:, None], org_tgts].min(1, mode="drop")
+    frontier1 = jnp.zeros((O, N), bool).at[
+        o1[:, None], org_tgts].set(True, mode="drop")
+    reached1 = frontier1.at[o1, origins].set(True)
 
-    def relax(carry):
-        dist, _ = carry
-        cand = jnp.where(dist < INF, dist + 1, INF)[:, :, None]
-        cand = jnp.broadcast_to(cand, tgt.shape)
-        new = dist.at[o3, tgt].min(cand, mode="drop")
-        return new, jnp.any(new != dist)
+    def bfs_body(carry):
+        frontier, reached, dist, h = carry
+        quiet = jnp.broadcast_to((~frontier)[:, :, None],
+                                 (O, N, F)).reshape(O, NF)
+        delta = jnp.concatenate(
+            [quiet.astype(jnp.int32), jnp.zeros((O, N), jnp.int32)], axis=1)
+        (s1,) = lax.sort((tgt2_base + delta,), dimension=-1, num_keys=1)
+        k2 = jnp.where(_boundary(s1 >> 1), s1, BIG)
+        (s2,) = lax.sort((k2,), dimension=-1, num_keys=1)
+        dense = s2[:, :N]                 # keys t*2 + (1 - any), t ascending
+        newly = ((dense & 1) == 0) & ~reached
+        dist = jnp.where(newly, h + 1, dist)
+        return (newly, reached | newly, dist, h + 1)
 
-    dist, _ = lax.while_loop(lambda c: c[1], relax,
-                             (dist0, jnp.bool_(True)))
-    reached = dist < INF
+    _, reached, dist, _ = lax.while_loop(
+        lambda c: jnp.any(c[0]), bfs_body,
+        (frontier1, reached1, dist0, jnp.int32(1)))
 
-    live = (tgt < N) & reached[:, :, None]
-    edge_tgt = jnp.where(live, tgt, N)
-    deg_out = jnp.sum(live, axis=-1, dtype=jnp.int32)            # [O, N]
-    n_reached = jnp.sum(reached, axis=-1, dtype=jnp.int32)       # [O]
+    # ---- delivered edges + verb 2: consume (gossip.rs:618-653) ----------
+    delivered = (tgt < N) & reached[:, :, None]                  # [O,N,F]
+    deg_out = jnp.sum(delivered, axis=-1, dtype=jnp.int32)       # egress
     m_push = jnp.sum(deg_out, axis=-1, dtype=jnp.int32)          # [O]
+    n_reached = jnp.sum(reached, axis=-1, dtype=jnp.int32)       # [O]
 
-    egress_round = deg_out
-    ingress_round = jnp.zeros((O, N), jnp.int32).at[o3, edge_tgt].add(
-        1, mode="drop")
+    hop1 = jnp.minimum(dist + 1, H - 1)                          # [O,N] per src
+    # per-edge payloads, src-major (free broadcasts)
+    kv = ((hop1[:, :, None] << 14) | iota_n[:, :, None]).astype(jnp.int32)
+    kv = jnp.broadcast_to(kv, (O, N, F)).reshape(O, NF)
+    shi_e = jnp.broadcast_to(tables.shi[None, :N, None], (O, N, F)).reshape(O, NF)
+    slo_e = jnp.broadcast_to(tables.slo[None, :N, None], (O, N, F)).reshape(O, NF)
+    kd = jnp.where(delivered, tgt, N).reshape(O, NF)
+    # one pseudo-edge per target (ranks after real: kv = BIG)
+    kd_c = jnp.concatenate([kd, pseudo_t], axis=1)               # [O, M1]
+    kv_c = jnp.concatenate([kv, jnp.full((O, N), BIG)], axis=1)
+    shi_c = jnp.concatenate([shi_e, jnp.zeros((O, N), jnp.int32)], axis=1)
+    slo_c = jnp.concatenate([slo_e, jnp.zeros((O, N), jnp.int32)], axis=1)
+    # rank inbound by (hop, src index) — index order equals the reference's
+    # pubkey-string sort by NodeIndex construction (gossip.rs:638-645)
+    st_, skv, shi_s, slo_s = lax.sort(
+        (kd_c, kv_c, shi_c, slo_c), dimension=-1, num_keys=2)
+    rank = _rank_in_run(st_)
+    is_pseudo = (skv == BIG) & (st_ < N)
+    real = (skv != BIG) & (st_ < N)
 
-    # ---- verb 2: consume (gossip.rs:618-653) ----------------------------
-    # Rank inbound edges per dest by (hop, src index) — index order equals
-    # the reference's pubkey-string sort by NodeIndex construction
-    # (gossip.rs:638-645; identity.NodeIndex).
-    hop1 = jnp.minimum(dist + 1, H - 1)
-    key1 = edge_tgt.reshape(O, N * S)
-    key2 = (hop1[:, :, None] * N + n_idx[:, :, None]).astype(jnp.int32)
-    key2 = jnp.broadcast_to(key2, (O, N, S)).reshape(O, N * S)
-    tgt_s, key2_s = lax.sort((key1, key2), dimension=-1, num_keys=2)
-    src_s = key2_s % N
-    eidx = jnp.arange(N * S, dtype=jnp.int32)[None, :]
-    is_start = jnp.concatenate(
-        [jnp.ones((O, 1), bool), tgt_s[:, 1:] != tgt_s[:, :-1]], axis=1)
-    seg_start = lax.cummax(jnp.where(is_start, eidx, 0), axis=1)
-    rank = eidx - seg_start
-    inb = jnp.full((O, N, K), N, jnp.int32).at[
-        o2, tgt_s, rank].set(src_s, mode="drop")
-    inb_dropped = jnp.sum((rank >= K) & (tgt_s < N), axis=-1, dtype=jnp.int32)
+    # ingress counts: the pseudo entry sorts last in its run, so its rank is
+    # the number of delivered edges into its target; compact runs -> [O, N].
+    ing_k = jnp.where(is_pseudo, st_, BIG)
+    _, ing_cnt = lax.sort((ing_k, rank), dimension=-1, num_keys=1)
+    ingress_round = ing_cnt[:, :N]                               # [O, N]
+    inb_dropped = jnp.sum(real & (rank >= K), axis=-1, dtype=jnp.int32)
 
-    # merge inbound into the received cache (received_cache.rs:83-98)
+    # inbound rows [O, N, K] via slot-aligned two-sort compaction
+    keep = real & (rank < K)
+    gk = jnp.where(keep, (st_ * K + rank) * 2, BIG)
+    slot_keys = jnp.broadcast_to(
+        jnp.arange(NK, dtype=jnp.int32)[None, :] * 2 + 1, (O, NK))
+    ga = jnp.concatenate([gk, slot_keys], axis=1)
+    kv_a = jnp.concatenate([skv, jnp.full((O, NK), BIG)], axis=1)
+    shi_a = jnp.concatenate([shi_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
+    slo_a = jnp.concatenate([slo_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
+    sA, kvA, hiA, loA = lax.sort((ga, kv_a, shi_a, slo_a),
+                                 dimension=-1, num_keys=1)
+    gB = jnp.where(_boundary(sA >> 1), sA, BIG)
+    sB, kvB, hiB, loB = lax.sort((gB, kvA, hiA, loA),
+                                 dimension=-1, num_keys=1)
+    inb_real = (sB[:, :NK] & 1) == 0
+    inb = jnp.where(inb_real, kvB[:, :NK] & (PACK - 1), N).reshape(O, N, K)
+    inb_shi = jnp.where(inb_real, hiB[:, :NK], 0).reshape(O, N, K)
+    inb_slo = jnp.where(inb_real, loB[:, :NK], 0).reshape(O, N, K)
+
+    # ---- received-cache merge (received_cache.rs:83-98) -----------------
     rc_src, rc_score = state.rc_src, state.rc_score
-    pos = _row_searchsorted(rc_src, inb)                         # [O, N, K]
-    pos_c = jnp.minimum(pos, C - 1)
-    found = (inb < N) & (pos < C) & (
-        jnp.take_along_axis(rc_src, pos_c, axis=-1) == inb)
-    for r in (0, 1):  # num_dups < NUM_DUPS_THRESHOLD -> score += 1
-        oh = (jnp.arange(C)[None, None, :] == pos_c[..., r:r + 1])
-        rc_score = rc_score + (oh & found[..., r:r + 1]).astype(jnp.int32)
+    rc_shi, rc_slo = state.rc_shi, state.rc_slo
+    kpos = jnp.arange(K, dtype=jnp.int32)[None, None, :]
 
+    # member lookup: one row sort by (src, tag), route flags back by slot
+    fk = jnp.concatenate([rc_src * 2, inb * 2 + 1], axis=-1)     # [O,N,C+K]
+    fpos = jnp.concatenate(
+        [jnp.broadcast_to(jnp.full((1, 1, C), BIG), (O, N, C)),
+         jnp.broadcast_to(kpos, (O, N, K))], axis=-1)
+    fk_s, fpos_s = lax.sort((fk, fpos), dimension=-1, num_keys=1)
+    dup_s = jnp.concatenate(
+        [jnp.zeros((O, N, 1), bool),
+         (fk_s[..., 1:] >> 1) == (fk_s[..., :-1] >> 1)], axis=-1)
+    back_k, back_d = lax.sort(
+        (fpos_s, dup_s.astype(jnp.int32)), dimension=-1, num_keys=1)
+    found = (back_d[..., :K] == 1) & (inb < N)                   # [O,N,K]
+
+    # rank-order capacity scan (received_cache.rs:92-97): scored ranks (< 2)
+    # insert unconditionally; the rest honor the 50-entry cap
     base_len = jnp.sum(rc_src < N, axis=-1, dtype=jnp.int32)
+    want = (inb < N) & ~found
+    ln = base_len
+    allowed_cols = []
+    for r in range(K):
+        a = want[..., r] & ((r < 2) | (ln < p.received_cap))
+        allowed_cols.append(a)
+        ln = ln + a.astype(jnp.int32)
+    allowed = jnp.stack(allowed_cols, axis=-1)                   # [O,N,K]
 
-    def ins_step(ln, x):
-        found_r, inb_r, r = x
-        want = (inb_r < N) & ~found_r
-        # scored ranks insert unconditionally; others honor the 50-entry cap
-        # (received_cache.rs:92-97)
-        allowed = want & ((r < 2) | (ln < p.received_cap))
-        return ln + allowed.astype(jnp.int32), allowed
-
-    _, allowed_t = lax.scan(
-        ins_step, base_len,
-        (jnp.moveaxis(found, -1, 0), jnp.moveaxis(inb, -1, 0),
-         jnp.arange(K)))
-    allowed = jnp.moveaxis(allowed_t, 0, -1)                     # [O, N, K]
-    acc_src = jnp.where(allowed, inb, N)
-    acc_score = (allowed & (jnp.arange(K)[None, None, :] < 2)).astype(jnp.int32)
-    acc_src, acc_score = lax.sort((acc_src, acc_score), dimension=-1, num_keys=1)
-
-    # merge two sorted-by-src lists via rank addition (no full re-sort)
-    n3 = jnp.arange(N)[None, :, None]
-    merged_src = jnp.full((O, N, C + K), N, jnp.int32)
-    merged_score = jnp.zeros((O, N, C + K), jnp.int32)
-    p_old = jnp.arange(C, dtype=jnp.int32) + _row_searchsorted(acc_src, rc_src)
-    p_old = jnp.where(rc_src < N, p_old, C + K)  # sentinels -> dropped
-    merged_src = merged_src.at[o3, n3, p_old].set(rc_src, mode="drop")
-    merged_score = merged_score.at[o3, n3, p_old].set(rc_score, mode="drop")
-    p_new = jnp.arange(K, dtype=jnp.int32) + _row_searchsorted(rc_src, acc_src)
-    p_new = jnp.where(acc_src < N, p_new, C + K)
-    merged_src = merged_src.at[o3, n3, p_new].set(acc_src, mode="drop")
-    merged_score = merged_score.at[o3, n3, p_new].set(acc_score, mode="drop")
-    rc_overflow = jnp.sum(merged_src[..., C:] < N, axis=(-2, -1),
+    # merge rows: score-bump carriers (found & rank<2) + allowed inserts
+    bump = found & (kpos < 2)
+    include = bump | allowed
+    contrib = (kpos < 2).astype(jnp.int32)                       # +1 / score 1
+    mk = jnp.concatenate(
+        [jnp.where(rc_src < N, rc_src * 2, BIG),
+         jnp.where(include, inb * 2 + 1, BIG)], axis=-1)         # [O,N,C+K]
+    msc = jnp.concatenate(
+        [rc_score, jnp.where(include, contrib, 0)], axis=-1)
+    mhi = jnp.concatenate([rc_shi, inb_shi], axis=-1)
+    mlo = jnp.concatenate([rc_slo, inb_slo], axis=-1)
+    mk_s, msc_s, mhi_s, mlo_s = lax.sort(
+        (mk, msc, mhi, mlo), dimension=-1, num_keys=1)
+    is_dup = jnp.concatenate(
+        [jnp.zeros((O, N, 1), bool),
+         ((mk_s[..., 1:] >> 1) == (mk_s[..., :-1] >> 1))
+         & ((mk_s[..., 1:] & 1) == 1)], axis=-1)
+    nxt_dup = jnp.concatenate([is_dup[..., 1:],
+                               jnp.zeros((O, N, 1), bool)], axis=-1)
+    nxt_sc = jnp.concatenate([msc_s[..., 1:],
+                              jnp.zeros((O, N, 1), jnp.int32)], axis=-1)
+    msc_s = msc_s + jnp.where(nxt_dup, nxt_sc, 0)                # bump old
+    valid_m = (mk_s != BIG) & ~is_dup
+    ck = jnp.where(valid_m, mk_s >> 1, BIG)
+    ck_s, csc, chi, clo = lax.sort(
+        (ck, msc_s, mhi_s, mlo_s), dimension=-1, num_keys=1)
+    n_valid = jnp.sum(valid_m, axis=-1, dtype=jnp.int32)
+    rc_overflow = jnp.sum(jnp.maximum(n_valid - C, 0), axis=(-1,),
                           dtype=jnp.int32)
-    rc_src = merged_src[..., :C]
-    rc_score = merged_score[..., :C]
+    rc_src = jnp.where(ck_s[..., :C] != BIG, ck_s[..., :C], N)
+    rc_score = jnp.where(ck_s[..., :C] != BIG, csc[..., :C], 0)
+    rc_shi = jnp.where(ck_s[..., :C] != BIG, chi[..., :C], 0)
+    rc_slo = jnp.where(ck_s[..., :C] != BIG, clo[..., :C], 0)
 
     any_inb = inb[..., 0] < N  # a rank-0 record is one upsert (received_cache.rs:85-87)
     rc_ups = state.rc_upserts + any_inb.astype(jnp.int32)
@@ -362,13 +494,17 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
                          * p.prune_stake_threshold).astype(jnp.int64)
 
     member = rc_src < N
-    m_stake = tables.stakes[rc_src]                              # pad -> 0
-    neg_score = jnp.where(member, -rc_score, jnp.iinfo(jnp.int32).max)
-    neg_stake = jnp.where(member, -m_stake, jnp.iinfo(jnp.int64).max)
-    _, _, src_sorted = lax.sort(
-        (neg_score, neg_stake, rc_src), dimension=-1, num_keys=3)
+    mx = jnp.iinfo(jnp.int32).max
+    neg_score = jnp.where(member, -rc_score, mx)
+    neg_hi = jnp.where(member, -rc_shi, mx)
+    neg_lo = jnp.where(member, -rc_slo, mx)
+    # (score desc, stake desc, src asc): stake split keeps i64 out of the sort
+    _, _, _, src_sorted, hi_sorted, lo_sorted = lax.sort(
+        (neg_score, neg_hi, neg_lo, rc_src, rc_shi, rc_slo),
+        dimension=-1, num_keys=4)
     memb_sorted = src_sorted < N
-    stake_sorted = tables.stakes[src_sorted]
+    stake_sorted = (hi_sorted.astype(jnp.int64) << 31) | lo_sorted.astype(
+        jnp.int64)
     cum_excl = jnp.cumsum(stake_sorted, axis=-1) - stake_sorted
     posn = jnp.arange(C)[None, None, :]
     pruned_slot = (memb_sorted
@@ -381,41 +517,74 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     # Prune messages count toward RMR's m (gossip.rs:684-687).
 
     # ---- verb 4: prune apply (push_active_set.rs:56-71,143-151) ---------
-    pr_sorted = lax.sort(jnp.where(pruned_slot, src_sorted, N), dimension=-1)
-    t_c = peer_c  # current active peers; prune touches existing entries only
-    q = jnp.broadcast_to(jnp.arange(N, dtype=jnp.int32)[None, :, None],
-                         (O, N, S))
-    lo = jnp.zeros((O, N, S), jnp.int32)
-    hi = jnp.full((O, N, S), C, jnp.int32)
-    for _ in range(max(1, math.ceil(math.log2(C))) + 1):
-        act = lo < hi
-        mid = (lo + hi) // 2
-        vals = _gather_rows(pr_sorted, t_c, jnp.minimum(mid, C - 1))
-        less = vals < q
-        lo = jnp.where(act & less, mid + 1, lo)
-        hi = jnp.where(act & ~less, mid, hi)
-    hit = (lo < C) & (_gather_rows(pr_sorted, t_c, jnp.minimum(lo, C - 1)) == q)
+    # pair (pruner=t, prunee=u) must set prunee u's slot bit for peer t:
+    # match key = peer * PACK + owner, shared by pairs and active-set edges.
+    NP = min(p.pa_slots, C)
+    pk_rows = jnp.where(pruned_slot, posn.astype(jnp.int32), C)
+    pk_s, psrc_s = lax.sort((pk_rows, src_sorted), dimension=-1, num_keys=1)
+    over_budget = jnp.any(pk_s[..., NP:NP + 1] < C) if NP < C else jnp.array(
+        False)
+    t_rows = jnp.broadcast_to(iota_n[:, :, None], (O, N, C))
+    pair_live = pk_s < C
+
+    edge_keys = (jnp.minimum(peer, N - 1) * PACK
+                 + iota_n[:, :, None]).reshape(O, N * S)
+    edge_keys = jnp.where(is_peer.reshape(O, N * S), edge_keys * 2 + 1, BIG)
+    edge_pos = jnp.broadcast_to(
+        jnp.arange(N * S, dtype=jnp.int32)[None, :], (O, N * S))
+
+    def _apply(np_slots):
+        pair_keys = jnp.where(
+            pair_live[..., :np_slots],
+            (t_rows[..., :np_slots] * PACK + psrc_s[..., :np_slots]) * 2,
+            BIG).reshape(O, N * np_slots)
+        # pair key = pruner*PACK + prunee; edge key = peer*PACK + owner:
+        # a hit means this slot's peer has pruned the owner for this origin
+        k = jnp.concatenate([edge_keys, pair_keys], axis=1)
+        ppos = jnp.concatenate(
+            [edge_pos, jnp.full((O, N * np_slots), BIG)], axis=1)
+        ks, pos_s = lax.sort((k, ppos), dimension=-1, num_keys=1)
+        hit_s = jnp.concatenate(
+            [jnp.zeros((O, 1), bool),
+             ((ks[:, 1:] >> 1) == (ks[:, :-1] >> 1))
+             & ((ks[:, 1:] & 1) == 1)], axis=1)
+        _, hit_back = lax.sort((pos_s, hit_s.astype(jnp.int32)),
+                               dimension=-1, num_keys=1)
+        return hit_back[:, :N * S].reshape(O, N, S) == 1
+
+    if NP < C:
+        hit = lax.cond(over_budget, lambda: _apply(C), lambda: _apply(NP))
+    else:
+        hit = _apply(C)
     pruned_bits = state.pruned | (hit & is_peer)
 
     # mem::take on fire: the whole entry resets (received_cache.rs:48-55)
     rc_src = jnp.where(fired[..., None], N, rc_src)
     rc_score = jnp.where(fired[..., None], 0, rc_score)
+    rc_shi = jnp.where(fired[..., None], 0, rc_shi)
+    rc_slo = jnp.where(fired[..., None], 0, rc_slo)
     rc_ups = jnp.where(fired, 0, rc_ups)
 
     # ---- verb 5: rotate (gossip.rs:739-754; push_active_set.rs:153-186) -
-    b = tables.buckets
-    k_os = jnp.minimum(b[None, :], b[origins][:, None])
     rot_u = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
         subs[:, 1])
     rotate = rot_u < p.probability_of_rotation
+    T = p.rot_tries
+    u_all = jax.vmap(
+        lambda ks: jax.vmap(
+            lambda k: jax.random.uniform(k, (N, 2), dtype=jnp.float32))(ks)
+    )(subs[:, 2:2 + T])                                          # [O, T, N, 2]
+    u_all = jnp.moveaxis(u_all, 1, 2)                            # [O, N, T, 2]
+    members = _sample_fast(tables, origins, u_all[..., 0], u_all[..., 1])
+    perm_t = jnp.broadcast_to(tables.sampler.perm[None, :], (O, N))
+    cands = _lookup(perm_t, members.reshape(O, N * T), N).reshape(O, N, T)
+
     chosen = jnp.full((O, N), N, jnp.int32)
     found_new = jnp.zeros((O, N), bool)
     self_i = jnp.arange(N, dtype=jnp.int32)[None, :]
     active_now = peer
-    for t in range(p.rot_tries):
-        u = jax.vmap(lambda k: jax.random.uniform(k, (N, 2), dtype=jnp.float32))(
-            subs[:, 2 + t])
-        cand = sample_peers(tables.sampler, k_os, u[..., 0], u[..., 1])
+    for t in range(T):
+        cand = cands[..., t]
         ok = ((cand != self_i)
               & ~jnp.any(active_now == cand[..., None], axis=-1))
         take = ok & ~found_new
@@ -423,23 +592,33 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         found_new = found_new | ok
     do_rot = rotate & found_new
     rot_failed = jnp.sum(rotate & ~found_new, axis=-1, dtype=jnp.int32)
+    chosen_failed = _lookup(
+        failed.astype(jnp.int32), jnp.minimum(chosen, N - 1), N) == 1
 
     mcnt = jnp.sum(active_now < N, axis=-1, dtype=jnp.int32)
     full_row = mcnt >= S
     shift_act = jnp.concatenate([active_now[..., 1:], chosen[..., None]], axis=-1)
     shift_prn = jnp.concatenate(
         [pruned_bits[..., 1:], jnp.zeros((O, N, 1), bool)], axis=-1)
+    shift_tf = jnp.concatenate(
+        [tfail[..., 1:], chosen_failed[..., None]], axis=-1)
     slot_oh = (jnp.arange(S)[None, None, :] == jnp.minimum(mcnt, S - 1)[..., None])
     append_act = jnp.where(slot_oh & ~full_row[..., None],
                            chosen[..., None], active_now)
+    append_tf = jnp.where(slot_oh & ~full_row[..., None],
+                          chosen_failed[..., None], tfail)
     new_active = jnp.where(do_rot[..., None],
                            jnp.where(full_row[..., None], shift_act, append_act),
                            active_now)
     new_pruned = jnp.where((do_rot & full_row)[..., None], shift_prn, pruned_bits)
+    new_tfail = jnp.where(do_rot[..., None],
+                          jnp.where(full_row[..., None], shift_tf, append_tf),
+                          tfail)
 
     # ---- statistics (gossip_stats.rs; on-device reductions) -------------
-    hr = jnp.zeros((O, H), jnp.int32).at[
-        o2, jnp.minimum(dist, H - 1)].add(reached.astype(jnp.int32))
+    hr = jnp.sum(
+        (jnp.minimum(dist, H - 1)[:, :, None] == jnp.arange(H)[None, None, :])
+        & reached[:, :, None], axis=1, dtype=jnp.int32)          # [O, H]
     pos_counts = hr.at[:, 0].set(0)          # HopsStat filters origin's 0 hops
     cnt = jnp.sum(pos_counts, axis=-1)
     hsum = jnp.sum(pos_counts * jnp.arange(H)[None, :], axis=-1)
@@ -468,11 +647,14 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         key=state.key,
         active=new_active,
         pruned=new_pruned,
+        tfail=new_tfail,
         rc_src=rc_src,
         rc_score=rc_score,
+        rc_shi=rc_shi,
+        rc_slo=rc_slo,
         rc_upserts=rc_ups,
         failed=failed,
-        egress_acc=state.egress_acc + g * egress_round,
+        egress_acc=state.egress_acc + g * deg_out,
         ingress_acc=state.ingress_acc + g * ingress_round,
         prune_acc=state.prune_acc + g * n_pruned,
         stranded_acc=state.stranded_acc + g * stranded.astype(jnp.int32),

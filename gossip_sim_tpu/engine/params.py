@@ -36,6 +36,9 @@ class EngineParams(NamedTuple):
     hist_bins: int = 64     # on-device hop-histogram bins
     rot_tries: int = 8      # rejection-sampling tries per rotation event
     init_draws: int = 64    # candidate draws per entry at initialization
+    pa_slots: int = 8       # prune-apply fast-path budget (pruned peers per
+                            # row per round); overflow falls back to the
+                            # full-width sort via lax.cond — exact either way
 
     @property
     def num_buckets(self) -> int:

@@ -40,6 +40,8 @@ class SamplerTables(NamedTuple):
     class_start: jax.Array   # [NB] i32 offset of each bucket class in perm
     class_count: jax.Array   # [NB] i32 nodes per bucket class
     class_cdf: jax.Array     # [NB, NB] f32 normalized inclusive CDF per entry k
+    cdf_own: jax.Array       # [N, NB] f32 == class_cdf[bucket(n)] (static
+                             # per-node row, avoids a per-node CDF gather)
 
 
 def build_sampler_tables(buckets: np.ndarray) -> SamplerTables:
@@ -65,6 +67,7 @@ def build_sampler_tables(buckets: np.ndarray) -> SamplerTables:
         class_start=jnp.asarray(class_start),
         class_count=jnp.asarray(class_count),
         class_cdf=jnp.asarray(cdf),
+        cdf_own=jnp.asarray(cdf[buckets]),
     )
 
 

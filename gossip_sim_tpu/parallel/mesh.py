@@ -7,8 +7,8 @@ gossip.rs:747) and its *absent* distributed backend (SURVEY.md §2.3) with a
   * 'origins' — embarrassingly parallel batch of independent single-origin
     sims; the primary scaling axis (shard O).
   * 'nodes'   — optional second axis sharding the per-origin [N, ...] state;
-    GSPMD turns the scatter-min frontier relaxation into
-    local-scatter + all-reduce-min over ICI.
+    GSPMD lowers the engine's sort-routed frontier/ranking steps to
+    sharded sorts with ICI collectives at the shard boundaries.
 """
 
 from __future__ import annotations
@@ -39,8 +39,11 @@ def state_shardings(mesh: Mesh, shard_nodes: bool = True) -> dict:
         "key": P("origins"),
         "active": P("origins", n),
         "pruned": P("origins", n),
+        "tfail": P("origins", n),
         "rc_src": P("origins", n),
         "rc_score": P("origins", n),
+        "rc_shi": P("origins", n),
+        "rc_slo": P("origins", n),
         "rc_upserts": P("origins", n),
         "failed": P("origins", n),
         "egress_acc": P("origins", n),

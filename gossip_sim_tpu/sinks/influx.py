@@ -284,10 +284,19 @@ class InfluxDB:
                 self.tracker.add_sent()
 
     def send_data_points(self, datapoint: InfluxDataPoint):
-        # Fire-and-forget sender thread (the reference spawns an async_std
-        # task per point, influx_db.rs:81-96).
-        threading.Thread(target=self._post, args=(datapoint.data(),),
-                         daemon=True).start()
+        # Async send like the reference (one async_std task per point,
+        # influx_db.rs:81-96), but through a single persistent worker so a
+        # slow endpoint can't accumulate thousands of live sender threads.
+        if not hasattr(self, "_send_q"):
+            import queue
+            self._send_q = queue.Queue()
+
+            def _worker():
+                while True:
+                    self._post(self._send_q.get())
+
+            threading.Thread(target=_worker, daemon=True).start()
+        self._send_q.put(datapoint.data())
 
 
 class InfluxThread:

@@ -26,6 +26,11 @@ class Histogram:
         self.num_buckets = 0
 
     def build(self, upper_bound, lower_bound, num_buckets, values):
+        # NOTE: like the reference (gossip_stats.rs:608-611), only
+        # ``bucket == num_buckets`` is clamped — when
+        # (upper-lower)/num_buckets truncates, in-range values near the
+        # upper bound land in buckets beyond num_buckets-1.  Kept for
+        # output parity with the reference's BTreeMap behavior.
         self.min_entry = int(lower_bound)
         self.max_entry = int(upper_bound)
         self.num_buckets = int(num_buckets)

@@ -1,0 +1,78 @@
+"""Bit-exactness check: engine.core (v1) vs engine.core2 (sort-routed v2).
+
+Historical validation tool for the v2 engine swap: it ran (and passed, all
+configs) at the revision where both ``engine/core.py`` (scatter/gather v1)
+and ``engine/core2.py`` (sort-routed v2) coexisted; check that revision out
+to re-run.  Both engines share RNG stream structure, so every row and every
+common state field must match exactly, round by round.
+
+Run with JAX_PLATFORMS=cpu.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gossip_sim_tpu.engine import core as c1
+from gossip_sim_tpu.engine import core2 as c2
+from gossip_sim_tpu.engine.params import EngineParams
+
+
+def check(n=60, n_origins=3, rounds=45, seed=7, **kw):
+    rng = np.random.default_rng(0)
+    stakes = rng.choice(np.arange(1, 50 * n), size=n, replace=False).astype(
+        np.int64) * 1_000_000_000
+    params = EngineParams(num_nodes=n, warm_up_rounds=0, **kw)
+    origins = jnp.arange(n_origins, dtype=jnp.int32)
+
+    t1 = c1.make_cluster_tables(stakes)
+    t2 = c2.make_cluster_tables(stakes)
+    s1 = c1.init_state(jax.random.PRNGKey(seed), t1, origins, params)
+    s2 = c2.init_state(jax.random.PRNGKey(seed), t2, origins, params)
+    np.testing.assert_array_equal(np.asarray(s1.active), np.asarray(s2.active),
+                                  err_msg="init active diverges")
+
+    for r in range(rounds):
+        s1, r1 = c1.round_step(params, t1, origins, s1, jnp.int32(r),
+                               detail=True)
+        s2, r2 = c2.round_step(params, t2, origins, s2, jnp.int32(r),
+                               detail=True)
+        for k in r1:
+            np.testing.assert_array_equal(
+                np.asarray(r1[k]), np.asarray(r2[k]),
+                err_msg=f"row {k!r} diverges at round {r} ({kw})")
+        for f in ("active", "pruned", "rc_src", "rc_score", "rc_upserts",
+                  "failed", "egress_acc", "ingress_acc", "prune_acc",
+                  "stranded_acc", "hops_hist_acc"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(s1, f)), np.asarray(getattr(s2, f)),
+                err_msg=f"state {f!r} diverges after round {r} ({kw})")
+        # v2-only invariants
+        tf = np.asarray(s2.tfail)
+        act = np.asarray(s2.active)
+        fl = np.asarray(s2.failed)
+        exp = np.zeros_like(tf)
+        for o in range(n_origins):
+            m = act[o] < n
+            exp[o][m] = fl[o][np.minimum(act[o], n - 1)][m]
+        np.testing.assert_array_equal(tf, exp,
+                                      err_msg=f"tfail invariant at {r}")
+        st = np.asarray(s2.rc_shi).astype(np.int64) << 31
+        st |= np.asarray(s2.rc_slo).astype(np.int64)
+        src = np.asarray(s2.rc_src)
+        m = src < n
+        np.testing.assert_array_equal(
+            st[m], stakes[src[m]], err_msg=f"rc stake payload at {r}")
+    print(f"OK rounds={rounds} {kw or ''}")
+
+
+if __name__ == "__main__":
+    check()
+    check(probability_of_rotation=0.5, rounds=30)
+    check(fail_at=5, fail_fraction=0.25, rounds=20)
+    check(inbound_cap=4, rc_slots=16, received_cap=12, rounds=30)
+    check(pa_slots=1, rounds=45)  # force the prune-apply fallback path
+    print("ALL EQUIVALENCE CHECKS PASSED")

@@ -1,4 +1,7 @@
 """Ad-hoc stage profiler for round_step on the real chip (not shipped)."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import time
 
 import jax

@@ -1,0 +1,184 @@
+"""Stage-level differential profiling of the v2 round at target shapes."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from gossip_sim_tpu.engine import EngineParams, init_state, make_cluster_tables
+from gossip_sim_tpu.engine import core as C
+
+REPS = 10
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+O = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+
+rng = np.random.default_rng(0)
+stakes = (np.exp(rng.normal(9.5, 2.0, N)).astype(np.int64) + 1) * 10**9
+tables = make_cluster_tables(stakes)
+params = EngineParams(num_nodes=N, warm_up_rounds=0)
+origins = jnp.arange(O, dtype=jnp.int32)
+state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+state = jax.block_until_ready(state)
+p = params
+S, F, Cc, K, H, T = (p.active_set_size, p.push_fanout, p.rc_slots,
+                     p.inbound_cap, p.hist_bins, p.rot_tries)
+NF, NK, NS = N * F, N * K, N * S
+
+
+def bench(name, make_fn, *args):
+    try:
+        @partial(jax.jit, static_argnums=(1,))
+        def run(args, k):
+            def body(c, i):
+                out = jnp.ravel(make_fn(*args, i + c))
+                pos = ((i * 1297 + c) % out.shape[0]).astype(jnp.int32)
+                return lax.dynamic_index_in_dim(
+                    out, pos, keepdims=False).astype(jnp.int32), None
+            c, _ = lax.scan(body, jnp.int32(0), jnp.arange(k))
+            return c
+        int(run(args, 1)); int(run(args, REPS + 1))
+        t1 = min(_t(run, args, 1) for _ in range(2))
+        t2 = min(_t(run, args, REPS + 1) for _ in range(2))
+        print(f"{name:46s} {(t2-t1)/REPS*1e3:9.3f} ms")
+    except Exception as e:
+        print(f"{name:46s} FAILED: {type(e).__name__} {str(e)[:90]}")
+
+
+def _t(run, args, k):
+    t0 = time.time()
+    int(run(args, k))
+    return time.time() - t0
+
+
+peer = state.active
+origin_col = origins[:, None, None]
+iota_n = jnp.arange(N, dtype=jnp.int32)[None, :]
+pseudo_t = jnp.broadcast_to(iota_n, (O, N))
+tgt = jnp.where(peer[..., :F] < N, peer[..., :F], N)
+tgtf = tgt.reshape(O, NF)
+dist = jnp.asarray(rng.integers(0, 12, (O, N)), jnp.int32)
+inbK = jnp.asarray(rng.integers(0, N + 1, (O, N, K)), jnp.int32)
+rc_src = jnp.sort(jnp.asarray(
+    rng.integers(0, N + 1, (O, N, Cc)), jnp.int32), axis=-1)
+rc_i = jnp.asarray(rng.integers(0, 1 << 20, (O, N, Cc)), jnp.int32)
+
+
+def verb1(st, i):
+    valid = (st.active + i * 0 < N) & (~st.pruned) & (st.active != origin_col)
+    skey = jnp.where(valid, jnp.arange(S, dtype=jnp.int32)[None, None, :], S)
+    return lax.sort((skey + i * 0, st.active, st.tfail.astype(jnp.int32)),
+                    dimension=-1, num_keys=1)[1]
+
+
+def bfs_hop(tgt_, fr, i):
+    contrib = (fr + i * 0 > 0)[:, :, None] & (tgt_ < N)
+    k_edge = jnp.where(tgt_ < N, tgt_ * 2 + jnp.where(contrib, 0, 1), C.BIG)
+    k1 = jnp.concatenate([k_edge.reshape(O, NF), pseudo_t * 2 + 1], axis=1)
+    (s1,) = lax.sort((k1,), dimension=-1, num_keys=1)
+    k2 = jnp.where(C._boundary(s1 >> 1), s1, C.BIG)
+    (s2,) = lax.sort((k2,), dimension=-1, num_keys=1)
+    return (s2[:, :N] & 1) == 0
+
+
+def verb2_sortchain(tgt_, dist_, i):
+    hop1 = jnp.minimum(dist_ + i * 0 + 1, H - 1)
+    kv = ((hop1[:, :, None] << 14) | iota_n[:, :, None]).astype(jnp.int32)
+    kv = jnp.broadcast_to(kv, (O, N, F)).reshape(O, NF)
+    shi_e = jnp.broadcast_to(tables.shi[None, :N, None], (O, N, F)).reshape(O, NF)
+    slo_e = jnp.broadcast_to(tables.slo[None, :N, None], (O, N, F)).reshape(O, NF)
+    kd = jnp.where(tgt_ < N, tgt_, N).reshape(O, NF)
+    kd_c = jnp.concatenate([kd, pseudo_t], axis=1)
+    kv_c = jnp.concatenate([kv, jnp.full((O, N), C.BIG)], axis=1)
+    shi_c = jnp.concatenate([shi_e, jnp.zeros((O, N), jnp.int32)], axis=1)
+    slo_c = jnp.concatenate([slo_e, jnp.zeros((O, N), jnp.int32)], axis=1)
+    st_, skv, shi_s, slo_s = lax.sort(
+        (kd_c, kv_c, shi_c, slo_c), dimension=-1, num_keys=2)
+    rank = C._rank_in_run(st_)
+    keep = (skv != C.BIG) & (st_ < N) & (rank < K)
+    gk = jnp.where(keep, (st_ * K + rank) * 2, C.BIG)
+    slot_keys = jnp.broadcast_to(
+        jnp.arange(NK, dtype=jnp.int32)[None, :] * 2 + 1, (O, NK))
+    ga = jnp.concatenate([gk, slot_keys], axis=1)
+    kv_a = jnp.concatenate([skv, jnp.full((O, NK), C.BIG)], axis=1)
+    shi_a = jnp.concatenate([shi_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
+    slo_a = jnp.concatenate([slo_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
+    sA, kvA, hiA, loA = lax.sort((ga, kv_a, shi_a, slo_a),
+                                 dimension=-1, num_keys=1)
+    gB = jnp.where(C._boundary(sA >> 1), sA, C.BIG)
+    sB, kvB, hiB, loB = lax.sort((gB, kvA, hiA, loA),
+                                 dimension=-1, num_keys=1)
+    return kvB[:, :NK]
+
+
+def rc_merge(rc, inb, i):
+    fk = jnp.concatenate([rc * 2, (inb + i * 0) * 2 + 1], axis=-1)
+    fpos = jnp.concatenate(
+        [jnp.broadcast_to(jnp.full((1, 1, Cc), C.BIG), (O, N, Cc)),
+         jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, None, :],
+                          (O, N, K))], axis=-1)
+    fk_s, fpos_s = lax.sort((fk, fpos), dimension=-1, num_keys=1)
+    back = lax.sort((fpos_s, fk_s), dimension=-1, num_keys=1)[1]
+    mk_s, a, b_, c_ = lax.sort((fk, fpos, fpos, fpos),
+                               dimension=-1, num_keys=1)
+    ck_s = lax.sort((mk_s, a, b_, c_), dimension=-1, num_keys=1)[0]
+    return back + ck_s
+
+
+def decide(rc, sc, i):
+    member = rc < N
+    mx = jnp.iinfo(jnp.int32).max
+    neg = jnp.where(member, -(sc + i * 0), mx)
+    return lax.sort((neg, neg, neg, rc, sc, sc),
+                    dimension=-1, num_keys=4)[3]
+
+
+def apply_small(st, i):
+    NP = p.pa_slots
+    edge_keys = (jnp.minimum(st.active, N - 1) * C.PACK
+                 + iota_n[:, :, None]).reshape(O, NS)
+    edge_keys = jnp.where((st.active < N).reshape(O, NS),
+                          edge_keys * 2 + 1, C.BIG) + i * 0
+    edge_pos = jnp.broadcast_to(
+        jnp.arange(NS, dtype=jnp.int32)[None, :], (O, NS))
+    pair_keys = jnp.full((O, N * NP), C.BIG)
+    k = jnp.concatenate([edge_keys, pair_keys], axis=1)
+    ppos = jnp.concatenate([edge_pos, jnp.full((O, N * NP), C.BIG)], axis=1)
+    ks, pos_s = lax.sort((k, ppos), dimension=-1, num_keys=1)
+    hit_s = jnp.concatenate(
+        [jnp.zeros((O, 1), bool),
+         ((ks[:, 1:] >> 1) == (ks[:, :-1] >> 1))], axis=1)
+    return lax.sort((pos_s, hit_s.astype(jnp.int32)),
+                    dimension=-1, num_keys=1)[1]
+
+
+def rotate(st, i):
+    u = jnp.asarray(rng.random((O, N, T, 2)), jnp.float32)
+    members = C._sample_fast(tables, origins, u[..., 0] + i * 0, u[..., 1])
+    perm_t = jnp.broadcast_to(tables.sampler.perm[None, :], (O, N))
+    cands = C._lookup(perm_t, members.reshape(O, N * T), N).reshape(O, N, T)
+    chosen = cands[..., 0]
+    cf = C._lookup(st.failed.astype(jnp.int32),
+                   jnp.minimum(chosen, N - 1), N)
+    return chosen + cf
+
+
+def sample_only(st, i):
+    u = jnp.asarray(rng.random((O, N, T, 2)), jnp.float32)
+    return C._sample_fast(tables, origins, u[..., 0] + i * 0, u[..., 1])
+
+
+fr0 = jnp.zeros((O, N), jnp.int32).at[:, 0].set(1)
+bench("verb1 compaction rowsort", verb1, state)
+bench("bfs single hop (2 sorts)", bfs_hop, tgt, fr0)
+bench("verb2 sort chain (3 big sorts)", verb2_sortchain, tgt, dist)
+bench("rc merge (4 row sorts approx)", rc_merge, rc_src, inbK)
+bench("decide 4-key row sort", decide, rc_src, rc_i)
+bench("apply small path (2 sorts)", apply_small, state)
+bench("rotate (sample+2 lookups)", rotate, state)
+bench("sample_fast only", sample_only, state)

@@ -1,4 +1,7 @@
 """Trustworthy per-round compute timing via differential scan lengths."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import sys
 import time
 
