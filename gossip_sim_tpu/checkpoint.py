@@ -18,7 +18,11 @@ import numpy as np
 
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2
+# v1 checkpoints predate the tfail/rc_shi/rc_slo SimState fields; all three
+# are derivable from active/failed/rc_src plus the cluster stake table, so
+# v1 files remain loadable when ``tables`` is passed to restore_sim_state.
+_READABLE_VERSIONS = (1, 2)
 
 # EngineParams fields that define array shapes; a mismatch makes the stored
 # state unusable under the new compile geometry.
@@ -51,7 +55,7 @@ def load_state(path: str, params=None):
     """
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
-        if meta.get("format_version") != _FORMAT_VERSION:
+        if meta.get("format_version") not in _READABLE_VERSIONS:
             raise ValueError(
                 f"unsupported checkpoint version {meta.get('format_version')}")
         arrays = {k[len("state."):]: z[k] for k in z.files
@@ -65,14 +69,34 @@ def load_state(path: str, params=None):
     return arrays, stored, meta
 
 
-def restore_sim_state(path: str, params=None):
-    """Read a checkpoint and rebuild a device-resident ``SimState``."""
+def restore_sim_state(path: str, params=None, tables=None):
+    """Read a checkpoint and rebuild a device-resident ``SimState``.
+
+    ``tables`` (a ``ClusterTables``) lets v1 checkpoints backfill the
+    derived fields added later (tfail, rc_shi, rc_slo).
+    """
     import jax.numpy as jnp
 
     from .engine import SimState
 
     arrays, stored, meta = load_state(path, params)
     missing = set(SimState._fields) - set(arrays)
+    derivable = {"tfail", "rc_shi", "rc_slo"}
+    if missing and missing <= derivable and tables is not None:
+        n = stored["num_nodes"]
+        active = arrays["active"]                      # [O, N, S], N = empty
+        failed = arrays["failed"]                      # [O, N] bool
+        stakes = np.asarray(tables.stakes)             # [N+1], pad 0 at N
+        if "tfail" in missing:
+            pad_failed = np.concatenate(
+                [failed, np.zeros((failed.shape[0], 1), bool)], axis=1)
+            arrays["tfail"] = np.take_along_axis(
+                pad_failed[:, :, None], np.minimum(active, n), axis=1)
+        if "rc_shi" in missing or "rc_slo" in missing:
+            rc_stake = stakes[np.minimum(arrays["rc_src"], n)]
+            arrays["rc_shi"] = (rc_stake >> 31).astype(np.int32)
+            arrays["rc_slo"] = (rc_stake & 0x7FFFFFFF).astype(np.int32)
+        missing = set(SimState._fields) - set(arrays)
     if missing:
         raise ValueError(f"checkpoint missing fields: {sorted(missing)}")
     return SimState(**{f: jnp.asarray(arrays[f]) for f in SimState._fields}), \

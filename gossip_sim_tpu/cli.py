@@ -37,6 +37,30 @@ log = logging.getLogger("gossip_sim_tpu")
 POOR_COVERAGE_THRESHOLD = 0.95  # gossip_main.rs:408
 
 
+def _warn_shape_truncation(rows, params) -> tuple[int, int]:
+    """Dense-shape divergence guard (engine rows -> loud warning).
+
+    The engine ranks at most ``k_inbound`` inbound edges per (dest, round)
+    and keeps ``rc_slots`` received-cache entries; anything beyond is
+    counted (``inb_dropped`` / ``rc_overflow``) but silently truncated,
+    at which point scoring diverges from received_cache.rs:83-98.  Surface
+    it instead of letting sweeps drift."""
+    dropped = int(np.asarray(rows["inb_dropped"]).sum())
+    overflow = int(np.asarray(rows["rc_overflow"]).sum())
+    if dropped:
+        log.warning(
+            "WARNING: %s inbound message(s) exceeded the engine's ranking "
+            "width (inbound_cap=%s) and were dropped from peer scoring — "
+            "results may diverge from the reference semantics. Raise "
+            "EngineParams.inbound_cap.", dropped, params.k_inbound)
+    if overflow:
+        log.warning(
+            "WARNING: %s received-cache entries exceeded rc_slots=%s and "
+            "were evicted early — prune decisions may diverge. Raise "
+            "EngineParams.rc_slots.", overflow, params.rc_slots)
+    return dropped, overflow
+
+
 def build_parser() -> argparse.ArgumentParser:
     """The reference CLI surface (gossip_main.rs:53-241) + TPU extensions."""
     p = argparse.ArgumentParser(
@@ -107,6 +131,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--origin-batch", type=int, default=0,
                    help="origins per device batch in --all-origins mode "
                         "(0 = auto)")
+    p.add_argument("--mesh-devices", type=int, default=0,
+                   help="devices to shard origin batches over in "
+                        "--all-origins mode (0 = all available)")
     p.add_argument("--checkpoint-path", default="",
                    help="save the final simulation state (SimState arrays + "
                         "params) to this .npz; reload via "
@@ -147,6 +174,7 @@ def config_from_args(args) -> Config:
         all_origins=args.all_origins,
         origin_batch=args.origin_batch,
         checkpoint_path=args.checkpoint_path,
+        mesh_devices=args.mesh_devices,
     )
 
 
@@ -325,6 +353,7 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
         state, rows = run_rounds(params, tables, origins, state, n_it,
                                  start_it=start_it, detail=True)
         rows = jax.tree_util.tree_map(np.asarray, rows)
+        _warn_shape_truncation(rows, params)
         if params.fail_at >= 0 and start_it <= params.fail_at < start_it + n_it:
             _record_failed()
         for t in range(n_it):
@@ -367,18 +396,29 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
     return stakes
 
 
-def run_all_origins(config: Config, json_rpc_url: str) -> dict:
+def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
+                    start_ts: str = "0", accounts=None,
+                    origin_indices=None) -> dict:
     """Origin-parallel mode (TPU extension, SURVEY.md §2.3): every node is an
-    origin, vmapped in batches; per-iteration cross-origin aggregates.
+    origin, vmapped in batches and sharded across the device mesh when more
+    than one device is available (``Config.mesh_devices``; 0 = all).
 
-    Returns a summary dict (also logged)."""
+    Emits the full aggregate stats suite from the on-device accumulators
+    (coverage/RMR/hops/LDH/stranded/branching + message histograms) and the
+    aggregate Influx series.  Returns a summary dict (also logged); the
+    ``stats`` key carries the finalized ``AllOriginsStats``.
+
+    ``accounts``/``origin_indices`` are injection points for tests and the
+    driver's multichip dryrun, which exercises exactly this code path."""
     import jax
     import jax.numpy as jnp
 
     from .engine import (EngineParams, init_state, make_cluster_tables,
                          run_rounds)
+    from .stats.aggregate import AllOriginsStats
 
-    accounts, _ = load_cluster_accounts(config, json_rpc_url)
+    if accounts is None:
+        accounts, _ = load_cluster_accounts(config, json_rpc_url)
     index = NodeIndex.from_stakes(accounts)
     N = len(index)
     params = EngineParams(
@@ -391,34 +431,89 @@ def run_all_origins(config: Config, json_rpc_url: str) -> dict:
         warm_up_rounds=config.warm_up_rounds,
     )
     tables = make_cluster_tables(index.stakes.astype(np.int64))
+
+    # ---- device mesh (parallel/mesh.py): origins axis is collective-free
+    mesh = None
+    n_dev = len(jax.devices())
+    mesh_dev = config.mesh_devices or n_dev
+    if mesh_dev > n_dev:
+        log.warning("WARNING: --mesh-devices %s > %s visible device(s); "
+                    "clamping", mesh_dev, n_dev)
+        mesh_dev = n_dev
+    if mesh_dev > 1:
+        from .parallel import make_mesh
+        mesh = make_mesh(mesh_dev, node_shards=1)
+        log.info("all-origins: sharding origin batches over %s devices",
+                 mesh_dev)
+
+    all_origins = (np.arange(N, dtype=np.int32) if origin_indices is None
+                   else np.asarray(origin_indices, dtype=np.int32))
+    total_o = len(all_origins)
     batch = config.origin_batch or max(1, min(64, (1 << 22) // max(N, 1)))
-    cov_sum = rmr_sum = 0.0
-    n_measured = 0
+    if mesh is not None:
+        batch = max(mesh_dev, batch // mesh_dev * mesh_dev)
+
+    agg = AllOriginsStats(index, params.hist_bins)
     t0 = time.time()
-    for lo in range(0, N, batch):
-        origins = jnp.arange(lo, min(lo + batch, N), dtype=jnp.int32)
+    for lo in range(0, total_o, batch):
+        chunk = all_origins[lo:lo + batch]
+        n_valid = len(chunk)
+        if mesh is not None and n_valid % mesh_dev != 0:
+            # pad the final batch to the mesh width; padded sims run but
+            # their columns/rows are sliced off before aggregation
+            pad = mesh_dev - n_valid % mesh_dev
+            chunk = np.concatenate([chunk, np.zeros(pad, np.int32)])
+        origins = jnp.asarray(chunk, dtype=jnp.int32)
         state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
                            params)
+        if mesh is not None:
+            from .parallel import shard_sim
+            state, origins = shard_sim(mesh, state, origins,
+                                       shard_nodes=False)
         state, rows = run_rounds(params, tables, origins, state,
                                  config.gossip_iterations)
-        cov = np.asarray(rows["coverage"])[config.warm_up_rounds:]
-        rmr = np.asarray(rows["rmr"])[config.warm_up_rounds:]
-        cov_sum += float(cov.sum())
-        rmr_sum += float(rmr.sum())
-        n_measured += cov.size
-        log.info("all-origins: %s/%s origins done", min(lo + batch, N), N)
+        rows = jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[..., :n_valid], rows)
+        state_np = jax.tree_util.tree_map(np.asarray, state)
+        state_np = type(state_np)(**{
+            f: getattr(state_np, f)[:n_valid] for f in state_np._fields})
+        agg.add_batch(rows, state_np, config.warm_up_rounds)
+        log.info("all-origins: %s/%s origins done",
+                 min(lo + n_valid, total_o), total_o)
     dt = time.time() - t0
+
+    if agg.measured_points == 0:
+        log.warning("WARNING: no measured rounds (iterations <= "
+                    "warm-up-rounds); skipping stats/influx")
+        return {
+            "num_nodes": N, "num_origins": total_o,
+            "iterations": config.gossip_iterations, "measured_points": 0,
+            "coverage_mean": 0.0, "rmr_mean": 0.0, "elapsed_s": dt,
+            "origin_iters_per_sec": total_o * config.gossip_iterations / dt,
+            "mesh_devices": mesh_dev if mesh is not None else 1,
+            "stats": agg,
+        }
+    agg.finalize(config)
+    _warn_shape_truncation(
+        {"inb_dropped": agg.inb_dropped, "rc_overflow": agg.rc_overflow},
+        params)
+    if config.print_stats:
+        agg.print_all()
+    agg.emit_influx(dp_queue, start_ts)
     summary = {
         "num_nodes": N,
-        "num_origins": N,
+        "num_origins": total_o,
         "iterations": config.gossip_iterations,
-        "measured_points": n_measured,
-        "coverage_mean": cov_sum / max(n_measured, 1),
-        "rmr_mean": rmr_sum / max(n_measured, 1),
+        "measured_points": agg.measured_points,
+        "coverage_mean": agg.coverage_stats.mean,
+        "rmr_mean": agg.rmr_stats.mean,
         "elapsed_s": dt,
-        "origin_iters_per_sec": N * config.gossip_iterations / dt,
+        "origin_iters_per_sec": total_o * config.gossip_iterations / dt,
+        "mesh_devices": mesh_dev if mesh is not None else 1,
+        "stats": agg,
     }
-    log.info("ALL-ORIGINS SUMMARY: %s", summary)
+    log.info("ALL-ORIGINS SUMMARY: %s",
+             {k: v for k, v in summary.items() if k != "stats"})
     return summary
 
 
@@ -668,10 +763,10 @@ def main(argv=None) -> int:
             log.error("--all-origins requires --backend tpu")
             return 1
         if dp_queue is not None:
-            log.warning("WARNING: --all-origins reports aggregates only; "
-                        "per-iteration Influx series are not emitted in "
-                        "this mode")
-        run_all_origins(config, args.json_rpc_url)
+            log.info("all-origins: emitting run-level aggregate Influx "
+                     "series (per-iteration series are a single-origin "
+                     "feature)")
+        run_all_origins(config, args.json_rpc_url, dp_queue, start_ts)
         if dp_queue is not None:
             dp = InfluxDataPoint()
             dp.set_last_datapoint()

@@ -25,7 +25,8 @@ Every cross-node data movement is therefore expressed as a *sort*:
     insertion and eviction.
   * Prune application (push_active_set.rs:56-71): pruner/prunee pairs and
     active-set edges meet in one shared sort keyed by
-    ``peer*16384 + owner``; a budgeted fast path handles the common
+    ``peer*pack + owner`` (pack = 2^ceil(log2(N)), floor 16384, so clusters
+    up to MAX_NODES = 32767 fit i32 keys); a budgeted fast path handles the common
     few-prunes case and a ``lax.cond`` falls back to the full-width sort
     when a row prunes more than ``pa_slots`` peers at once.
   * Weighted sampling (push_active_set.rs:96-111): the stake-class CDF is
@@ -59,7 +60,21 @@ from .sampler import SamplerTables, build_sampler_tables
 
 INF = jnp.int32(1 << 20)   # unreached sentinel (maps to u64::MAX, gossip.rs:490)
 BIG = jnp.int32(0x7FFFFFFF)
-PACK = 16384               # node-id packing base; requires num_nodes < 16384
+# Node-id packing base for the shared i32 sort keys (peer*pack + owner).
+# Chosen per cluster: 16384 keeps the round-4 key layout for N < 16384, one
+# extra bit covers N up to MAX_NODES.  The binding constraint is
+# ((N-1)*pack + N-1)*2 + 1 < 2^31 with pack = 2^ceil(log2(N)), which holds
+# through N = 32768 but collides with the BIG sentinel exactly there — so
+# the supported bound is 32767.  Beyond that the packed keys need i64 sorts
+# (TPU-emulated, ~2x cost); not implemented.
+MAX_NODES = 32767
+PACK = 16384               # default packing base (clusters with N < 16384)
+
+
+def _pack_base(num_nodes: int) -> int:
+    """Packing base for ``num_nodes`` node ids: smallest power of two >= N
+    (floored at the historical 16384 so small clusters keep round-4 keys)."""
+    return 1 << max(14, (num_nodes - 1).bit_length())
 
 
 class ClusterTables(NamedTuple):
@@ -95,9 +110,12 @@ class SimState(NamedTuple):
 def make_cluster_tables(stakes_lamports: np.ndarray) -> ClusterTables:
     """Build static device tables from the per-node stake vector."""
     stakes = np.asarray(stakes_lamports, dtype=np.int64)
-    assert stakes.shape[0] < PACK, (
-        f"engine packs node ids into 14 bits; num_nodes must be < {PACK}")
-    assert (stakes >= 0).all() and (stakes < (1 << 62)).all()
+    if stakes.shape[0] > MAX_NODES:
+        raise ValueError(
+            f"engine packs node ids into i32 sort keys; num_nodes must be "
+            f"<= {MAX_NODES}, got {stakes.shape[0]}")
+    if not ((stakes >= 0).all() and (stakes < (1 << 62)).all()):
+        raise ValueError("stakes must be in [0, 2^62)")
     buckets = stake_buckets_array(stakes.astype(np.uint64)).astype(np.int32)
     padded = np.concatenate([stakes, [0]])
     return ClusterTables(
@@ -128,13 +146,20 @@ def _rank_in_run(run_of: jax.Array) -> jax.Array:
     return iot - start
 
 
-def _lookup(table_vals: jax.Array, queries: jax.Array, n: int) -> jax.Array:
+def _lookup(table_vals: jax.Array, queries: jax.Array, n: int,
+            pack: int = PACK) -> jax.Array:
     """Sort-join table lookup: ``table_vals[queries]`` without a gather.
 
     table_vals: [O, n] i32 per-origin table; queries: [O, M] i32 in [0, n).
     Entries and queries meet in one sort keyed by value; each value-run is
     headed by its (unique, always-present) table entry, whose payload is
     forward-filled through the run and routed back by original position.
+
+    PRECONDITION (fast path): table values must lie in [0, pack) — the
+    forward fill packs them as ``position*pack + value`` in i32 and recovers
+    them with ``% pack``; out-of-range values would be silently corrupted.
+    Current callers pass perm indices (< n <= pack) and 0/1 flags.  The
+    log-shift fallback (taken when W*pack > 2^31) has no such bound.
     """
     O, M = queries.shape
     W = n + M
@@ -150,12 +175,12 @@ def _lookup(table_vals: jax.Array, queries: jax.Array, n: int) -> jax.Array:
             jnp.arange(M, dtype=jnp.int32)[None, :], (O, M))], axis=1)
     sk, sv, sp = lax.sort((keys, vals, pos), dimension=-1, num_keys=1)
     have = (sk & 1) == 0
-    if W * PACK <= (1 << 31):
+    if W * pack <= (1 << 31):
         # forward fill via one packed cummax: a query's head is the nearest
         # table entry to its left (its own value-run always starts with one)
         iw = jnp.arange(W, dtype=jnp.int32)[None, :]
-        packed = jnp.where(have, iw * PACK + sv.astype(jnp.int32), -1)
-        fill = lax.cummax(packed, axis=1) % PACK
+        packed = jnp.where(have, iw * pack + sv.astype(jnp.int32), -1)
+        fill = lax.cummax(packed, axis=1) % pack
     else:
         run = sk >> 1
         fill = jnp.where(have, sv, 0)
@@ -222,6 +247,7 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
     """
     p = params.validate()
     N, S, E = p.num_nodes, p.active_set_size, p.init_draws
+    pack = _pack_base(N)
     O = int(origins.shape[0])
     origins = origins.astype(jnp.int32)
 
@@ -238,7 +264,8 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
         ek = jax.vmap(jax.random.fold_in, in_axes=(0, None))(draw_keys, e)
         u = jax.vmap(lambda k: jax.random.uniform(k, (N, 2), dtype=jnp.float32))(ek)
         member = _sample_fast(tables, origins, u[..., 0:1], u[..., 1:2])
-        cand = _lookup(perm_t, member[..., 0].reshape(O, N), N).reshape(O, N)
+        cand = _lookup(perm_t, member[..., 0].reshape(O, N), N,
+                       pack).reshape(O, N)
         dup = jnp.any(buf == cand[..., None], axis=-1) | (cand == self_idx)
         ins = (~dup) & (cnt <= S)
         slot = jnp.minimum(cnt, S)
@@ -282,8 +309,10 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     """One full gossip round for all O origin-sims.  Returns (state, rows)."""
     p = params
     N, S, F, C, K, H = (p.num_nodes, p.active_set_size, p.push_fanout,
-                        p.rc_slots, p.inbound_cap, p.hist_bins)
+                        p.rc_slots, p.k_inbound, p.hist_bins)
     F = min(F, S)
+    pack = _pack_base(N)
+    pb = pack.bit_length() - 1          # node-id bits in shared sort keys
     O = int(origins.shape[0])
     origins = origins.astype(jnp.int32)
     o1 = jnp.arange(O)
@@ -309,7 +338,8 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
             f = f | (r <= kth)
             # rebuild per-slot target-failed bits via sort-join (once)
             q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
-            tf = _lookup(f.astype(jnp.int32), q, N).reshape(O, N, S) == 1
+            tf = _lookup(f.astype(jnp.int32), q, N,
+                         pack).reshape(O, N, S) == 1
             return f, tf & (state.active < N)
         failed, tfail = lax.cond(it == p.fail_at, _fail,
                                  lambda ft: ft, (failed, tfail))
@@ -372,7 +402,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
 
     hop1 = jnp.minimum(dist + 1, H - 1)                          # [O,N] per src
     # per-edge payloads, src-major (free broadcasts)
-    kv = ((hop1[:, :, None] << 14) | iota_n[:, :, None]).astype(jnp.int32)
+    kv = ((hop1[:, :, None] << pb) | iota_n[:, :, None]).astype(jnp.int32)
     kv = jnp.broadcast_to(kv, (O, N, F)).reshape(O, NF)
     shi_e = jnp.broadcast_to(tables.shi[None, :N, None], (O, N, F)).reshape(O, NF)
     slo_e = jnp.broadcast_to(tables.slo[None, :N, None], (O, N, F)).reshape(O, NF)
@@ -412,7 +442,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     sB, kvB, hiB, loB = lax.sort((gB, kvA, hiA, loA),
                                  dimension=-1, num_keys=1)
     inb_real = (sB[:, :NK] & 1) == 0
-    inb = jnp.where(inb_real, kvB[:, :NK] & (PACK - 1), N).reshape(O, N, K)
+    inb = jnp.where(inb_real, kvB[:, :NK] & (pack - 1), N).reshape(O, N, K)
     inb_shi = jnp.where(inb_real, hiB[:, :NK], 0).reshape(O, N, K)
     inb_slo = jnp.where(inb_real, loB[:, :NK], 0).reshape(O, N, K)
 
@@ -518,7 +548,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
 
     # ---- verb 4: prune apply (push_active_set.rs:56-71,143-151) ---------
     # pair (pruner=t, prunee=u) must set prunee u's slot bit for peer t:
-    # match key = peer * PACK + owner, shared by pairs and active-set edges.
+    # match key = peer * pack + owner, shared by pairs and active-set edges.
     NP = min(p.pa_slots, C)
     pk_rows = jnp.where(pruned_slot, posn.astype(jnp.int32), C)
     pk_s, psrc_s = lax.sort((pk_rows, src_sorted), dimension=-1, num_keys=1)
@@ -527,7 +557,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     t_rows = jnp.broadcast_to(iota_n[:, :, None], (O, N, C))
     pair_live = pk_s < C
 
-    edge_keys = (jnp.minimum(peer, N - 1) * PACK
+    edge_keys = (jnp.minimum(peer, N - 1) * pack
                  + iota_n[:, :, None]).reshape(O, N * S)
     edge_keys = jnp.where(is_peer.reshape(O, N * S), edge_keys * 2 + 1, BIG)
     edge_pos = jnp.broadcast_to(
@@ -536,9 +566,9 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     def _apply(np_slots):
         pair_keys = jnp.where(
             pair_live[..., :np_slots],
-            (t_rows[..., :np_slots] * PACK + psrc_s[..., :np_slots]) * 2,
+            (t_rows[..., :np_slots] * pack + psrc_s[..., :np_slots]) * 2,
             BIG).reshape(O, N * np_slots)
-        # pair key = pruner*PACK + prunee; edge key = peer*PACK + owner:
+        # pair key = pruner*pack + prunee; edge key = peer*pack + owner:
         # a hit means this slot's peer has pruned the owner for this origin
         k = jnp.concatenate([edge_keys, pair_keys], axis=1)
         ppos = jnp.concatenate(
@@ -577,7 +607,8 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     u_all = jnp.moveaxis(u_all, 1, 2)                            # [O, N, T, 2]
     members = _sample_fast(tables, origins, u_all[..., 0], u_all[..., 1])
     perm_t = jnp.broadcast_to(tables.sampler.perm[None, :], (O, N))
-    cands = _lookup(perm_t, members.reshape(O, N * T), N).reshape(O, N, T)
+    cands = _lookup(perm_t, members.reshape(O, N * T), N,
+                    pack).reshape(O, N, T)
 
     chosen = jnp.full((O, N), N, jnp.int32)
     found_new = jnp.zeros((O, N), bool)
@@ -593,7 +624,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     do_rot = rotate & found_new
     rot_failed = jnp.sum(rotate & ~found_new, axis=-1, dtype=jnp.int32)
     chosen_failed = _lookup(
-        failed.astype(jnp.int32), jnp.minimum(chosen, N - 1), N) == 1
+        failed.astype(jnp.int32), jnp.minimum(chosen, N - 1), N, pack) == 1
 
     mcnt = jnp.sum(active_now < N, axis=-1, dtype=jnp.int32)
     full_row = mcnt >= S

@@ -32,7 +32,9 @@ class EngineParams(NamedTuple):
     # Dense-shape knobs (TPU formulation only; see engine/core.py for the
     # documented divergences they introduce):
     rc_slots: int = 64      # physical received-cache slots per (origin, node)
-    inbound_cap: int = 16   # inbound peers ranked per (origin, dest, round)
+    inbound_cap: int = 0    # inbound peers ranked per (origin, dest, round);
+                            # 0 = auto: max(16, 2*push_fanout) so fanout
+                            # sweeps can't silently truncate scoring
     hist_bins: int = 64     # on-device hop-histogram bins
     rot_tries: int = 8      # rejection-sampling tries per rotation event
     init_draws: int = 64    # candidate draws per entry at initialization
@@ -44,13 +46,24 @@ class EngineParams(NamedTuple):
     def num_buckets(self) -> int:
         return NUM_PUSH_ACTIVE_SET_ENTRIES
 
+    @property
+    def k_inbound(self) -> int:
+        """Resolved inbound ranking width (``inbound_cap``; 0 = auto-size
+        from the fanout).  Truncation beyond this is counted per round in
+        ``rows["inb_dropped"]`` and warned about by the CLI."""
+        if self.inbound_cap > 0:
+            return self.inbound_cap
+        return max(16, 2 * self.push_fanout)
+
     def validate(self) -> "EngineParams":
         assert self.num_nodes >= 2
+        # The node-id cap (engine/core.py MAX_NODES) is enforced with a
+        # ValueError in make_cluster_tables.
         # Enough physical slots for the reference's insert cap (or for every
         # possible peer, whichever is smaller) so the 50-entry cap semantics
         # (received_cache.rs:78) hold without overflow eviction.
         assert self.rc_slots >= min(self.received_cap, self.num_nodes - 1), (
             "rc_slots too small for the received-cache insert cap")
-        assert self.inbound_cap >= 2, "need at least the two scored ranks"
+        assert self.k_inbound >= 2, "need at least the two scored ranks"
         assert self.init_draws > self.active_set_size
         return self

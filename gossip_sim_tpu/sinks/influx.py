@@ -264,6 +264,8 @@ class InfluxDB:
         self.password = password
         self.tracker = tracker
         self.timeout = timeout
+        self._send_q = None
+        self._send_lock = threading.Lock()
 
     def _post(self, body: str):
         url = f"{self.url}?{urllib.parse.urlencode({'db': self.database})}"
@@ -287,15 +289,23 @@ class InfluxDB:
         # Async send like the reference (one async_std task per point,
         # influx_db.rs:81-96), but through a single persistent worker so a
         # slow endpoint can't accumulate thousands of live sender threads.
-        if not hasattr(self, "_send_q"):
-            import queue
-            self._send_q = queue.Queue()
+        with self._send_lock:
+            if self._send_q is None:
+                import queue
+                self._send_q = queue.Queue()
 
-            def _worker():
-                while True:
-                    self._post(self._send_q.get())
+                def _worker():
+                    while True:
+                        body = self._send_q.get()
+                        try:
+                            self._post(body)
+                        except Exception as err:  # one bad point must not
+                            # kill the drain: _post counts sent in finally,
+                            # but anything else raised here would leave the
+                            # Tracker unequal and InfluxThread hung forever
+                            log.error("influx sender error: %s", err)
 
-            threading.Thread(target=_worker, daemon=True).start()
+                threading.Thread(target=_worker, daemon=True).start()
         self._send_q.put(datapoint.data())
 
 

@@ -1,0 +1,246 @@
+"""Cross-origin aggregate statistics for ``--all-origins`` mode.
+
+The single-origin path harvests per-iteration detail arrays and feeds the
+reference-shaped ``GossipStats`` (gossip_stats.rs:1228-1884).  At all-origins
+scale (N origins x iterations) that would mean shipping [O, N] detail off
+device every round, so the engine instead accumulates everything on device —
+``hops_hist_acc``, ``stranded_acc``, ``egress/ingress/prune_acc`` plus the
+per-round scalar rows — and this module turns those accumulators into the
+same statistics the reference prints and reports: coverage/RMR collections
+(gossip_stats.rs:229-347), aggregate-hop and last-delivery-hop stats
+(gossip_stats.rs:27-227), the 11 stranded-node stats (gossip_stats.rs:
+964-1038), branching factor, and the stake-bucketed message histograms
+(gossip_stats.rs:359-461).
+
+Divergence note: aggregate hop mean/median/max come from the on-device hop
+histogram, whose top bin clamps hops >= hist_bins-1 (64 by default, far
+above the ~11-hop diameters seen in practice, README.md:232-241).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .collections import StatCollection
+from .histogram import Histogram
+from .hops import HopsStat
+from .stranded import StrandedNodeCollection
+from .trackers import EgressIngressMessageTracker
+
+log = logging.getLogger(__name__)
+
+
+class HistogramHopsStat:
+    """HopsStat (mean/median/max/min, zeros filtered) computed from binned
+    counts instead of raw values (gossip_stats.rs:46-98 semantics)."""
+
+    def __init__(self, counts: np.ndarray):
+        counts = np.asarray(counts, dtype=np.int64).copy()
+        if counts.size:
+            counts[0] = 0               # hop 0 = the origin itself; filtered
+        total = int(counts.sum())
+        if total == 0:
+            self.mean, self.median, self.max, self.min = 0.0, 0.0, 0, 0
+            return
+        hops = np.arange(counts.size, dtype=np.int64)
+        self.mean = float((hops * counts).sum() / total)
+        cum = np.cumsum(counts)
+        lo_i, hi_i = (total - 1) // 2, total // 2
+        lo_v = int(np.searchsorted(cum, lo_i, side="right"))
+        hi_v = int(np.searchsorted(cum, hi_i, side="right"))
+        self.median = (lo_v + hi_v) / 2.0
+        nz = np.nonzero(counts)[0]
+        self.max = int(nz[-1])
+        self.min = int(nz[0])
+
+
+class AllOriginsStats:
+    """Aggregates engine rows + on-device accumulators across origin batches
+    into reference-shaped statistics."""
+
+    def __init__(self, index, hist_bins: int):
+        self.index = index               # NodeIndex (pubkeys <-> stakes)
+        self.N = len(index)
+        self.hist_bins = hist_bins
+        self.coverage_stats = StatCollection("Coverage")
+        self.rmr_stats = StatCollection("RMR")
+        self.branching_stats = StatCollection("Outbound Branching Factor")
+        self.ldh_values = []             # per (measured round, origin) max hop
+        self.hops_hist = np.zeros(hist_bins, np.int64)
+        self.stranded_counts = np.zeros(self.N, np.int64)
+        self.egress = np.zeros(self.N, np.int64)
+        self.ingress = np.zeros(self.N, np.int64)
+        self.prunes = np.zeros(self.N, np.int64)
+        self.measured_points = 0         # (round, origin) pairs measured
+        self.num_origins = 0
+        self.inb_dropped = 0
+        self.rc_overflow = 0
+        # filled by finalize():
+        self.aggregate_hops = HopsStat()
+        self.ldh_stats = HopsStat()
+        self.stranded = StrandedNodeCollection()
+        self.hops_histogram = Histogram()
+        self.egress_tracker = EgressIngressMessageTracker()
+        self.ingress_tracker = EgressIngressMessageTracker()
+        self.prune_tracker = EgressIngressMessageTracker()
+
+    # -- per-batch accumulation -------------------------------------------
+
+    def add_batch(self, rows, state, warm_up_rounds: int):
+        """Fold one origin batch's rows (leading [iters] axis) + final
+        SimState accumulators (already warm-up-gated on device)."""
+        cov = np.asarray(rows["coverage"])[warm_up_rounds:]
+        if cov.size:
+            # bulk-extend (C speed) — measured_points reaches ~1e7 at the
+            # 10k-origins x 1000-iterations target, so no per-value pushes
+            self.coverage_stats.collection.extend(
+                cov.ravel().astype(float).tolist())
+            self.rmr_stats.collection.extend(
+                np.asarray(rows["rmr"])[warm_up_rounds:]
+                .ravel().astype(float).tolist())
+            self.branching_stats.collection.extend(
+                np.asarray(rows["branching"])[warm_up_rounds:]
+                .ravel().astype(float).tolist())
+            self.ldh_values.extend(
+                np.asarray(rows["hop_max"])[warm_up_rounds:]
+                .ravel().tolist())
+        self.hops_hist += np.asarray(state.hops_hist_acc,
+                                     dtype=np.int64).sum(axis=0)
+        self.stranded_counts += np.asarray(state.stranded_acc,
+                                           dtype=np.int64).sum(axis=0)
+        self.egress += np.asarray(state.egress_acc, np.int64).sum(axis=0)
+        self.ingress += np.asarray(state.ingress_acc, np.int64).sum(axis=0)
+        self.prunes += np.asarray(state.prune_acc, np.int64).sum(axis=0)
+        self.inb_dropped += int(np.asarray(rows["inb_dropped"]).sum())
+        self.rc_overflow += int(np.asarray(rows["rc_overflow"]).sum())
+        self.measured_points += int(cov.size)
+        self.num_origins += int(np.asarray(rows["coverage"]).shape[-1])
+
+    # -- end-of-run -------------------------------------------------------
+
+    def finalize(self, config):
+        self.coverage_stats.calculate_stats()
+        self.rmr_stats.calculate_stats()
+        self.branching_stats.calculate_stats()
+        hstat = HistogramHopsStat(self.hops_hist)
+        self.aggregate_hops = hstat
+        self.ldh_stats = HopsStat(self.ldh_values)
+
+        # Stranded collection from the per-node strand counts; mirrors
+        # insert_nodes called once per (origin, measured round)
+        # (gossip_stats.rs:1040-1061).
+        c = self.stranded
+        stakes_arr = self.index.stakes
+        c.stranded_nodes = {
+            self.index.pubkeys[i]: (int(stakes_arr[i]),
+                                    int(self.stranded_counts[i]))
+            for i in np.nonzero(self.stranded_counts)[0]}
+        c.total_gossip_iterations = self.measured_points
+        c.total_nodes = self.N
+        c.calculate_stats()
+        # a node can be stranded once per (origin sim, measured round), so
+        # the count bound is measured_points, not measured rounds
+        c.build_histogram(max(self.measured_points, 1), 0,
+                          config.num_buckets_for_stranded_node_hist)
+
+        # Aggregate hop histogram, rebucketed to the CLI bound like the
+        # single-origin path (gossip_main.rs:567-578).  Rebucket the 64 bin
+        # *counts* directly — expanding to raw values would materialize
+        # ~origins x rounds x N entries at target scale.
+        from ..constants import STANDARD_HISTOGRAM_UPPER_BOUND
+        self.hops_histogram.build_from_counts(
+            STANDARD_HISTOGRAM_UPPER_BOUND, 0,
+            config.num_buckets_for_hops_stats_hist,
+            {h: int(c) for h, c in enumerate(self.hops_hist) if h > 0 and c})
+
+        stakes_map = {pk: int(s)
+                      for pk, s in zip(self.index.pubkeys, stakes_arr)}
+        for tracker, counts in ((self.egress_tracker, self.egress),
+                                (self.ingress_tracker, self.ingress),
+                                (self.prune_tracker, self.prunes)):
+            tracker.counts = {self.index.pubkeys[i]: int(counts[i])
+                              for i in range(self.N)}
+            tracker.build_histogram(config.num_buckets_for_message_hist,
+                                    stakes_map)
+            tracker.normalize_message_counts()
+
+    # -- output -----------------------------------------------------------
+
+    def _print_sc(self, sc):
+        log.info("%s Mean: %.6f", sc.collection_type, sc.mean)
+        log.info("%s Median: %.6f", sc.collection_type, sc.median)
+        log.info("%s Max: %.6f", sc.collection_type, sc.max)
+        log.info("%s Min: %.6f", sc.collection_type, sc.min)
+
+    def print_all(self):
+        """The reference's print_all shape (gossip_stats.rs:1869-1883),
+        aggregated over every origin."""
+        log.info("|--- ALL-ORIGINS AGGREGATE: %s origins x %s measured "
+                 "points ---|", self.num_origins, self.measured_points)
+        log.info("|---- COVERAGE STATS ----|")
+        self._print_sc(self.coverage_stats)
+        log.info("|---- RELATIVE MESSAGE REDUNDANCY (RMR) STATS ----|")
+        self._print_sc(self.rmr_stats)
+        log.info("|---- AGGREGATE HOP STATS ----|")
+        log.info("Aggregate Hops Mean: %.6f", self.aggregate_hops.mean)
+        log.info("Aggregate Hops Median: %.2f", self.aggregate_hops.median)
+        log.info("Aggregate Hops Max: %s", self.aggregate_hops.max)
+        ldh = self.ldh_stats
+        log.info("|---- LAST DELIVERY HOP STATS ----|")
+        log.info("LDH Mean: %.6f  Median: %.2f  Max: %s  Min: %s",
+                 ldh.mean, ldh.median, ldh.max, ldh.min)
+        c = self.stranded
+        log.info("|---- STRANDED NODE STATS ----|")
+        log.info("Total stranded node iterations: %s",
+                 c.total_stranded_iterations)
+        log.info("Mean iterations a node was stranded: %.6f",
+                 c.stranded_iterations_per_node)
+        log.info("Mean nodes stranded per iteration: %.6f",
+                 c.mean_stranded_per_iteration)
+        log.info("Mean iterations a stranded node was stranded: %.6f",
+                 c.mean_stranded_iterations_per_stranded_node)
+        log.info("Median iterations a stranded node was stranded: %s",
+                 c.median_stranded_iterations_per_stranded_node)
+        log.info("Mean stake: %.2f  Median stake: %s  Max: %s  Min: %s",
+                 c.stranded_node_mean_stake, c.stranded_node_median_stake,
+                 c.stranded_node_max_stake, c.stranded_node_min_stake)
+        log.info("Mean weighted stake: %.2f  Median weighted stake: %s",
+                 c.weighted_stranded_node_mean_stake,
+                 c.weighted_stranded_node_median_stake)
+        log.info("Total stranded nodes: %s", c.stranded_count())
+        log.info("|---- OUTBOUND BRANCHING FACTOR ----|")
+        self._print_sc(self.branching_stats)
+
+    def emit_influx(self, dp_queue, start_ts: str):
+        """Aggregate versions of the reference series
+        (influx_db.rs:346-602), one point per run."""
+        if dp_queue is None:
+            return
+        from ..sinks import InfluxDataPoint
+
+        dp = InfluxDataPoint(start_ts, 0)
+        dp.create_data_point(self.coverage_stats.mean, "coverage")
+        dp.create_rmr_data_point((self.rmr_stats.mean, 0, 0))
+        dp.create_hops_stat_point(self.aggregate_hops)
+        dp.create_data_point(self.branching_stats.mean, "branching_factor")
+        c = self.stranded
+        dp.create_stranded_iteration_point(
+            c.total_stranded_iterations,
+            c.stranded_iterations_per_node,
+            c.mean_stranded_per_iteration,
+            c.mean_stranded_iterations_per_stranded_node,
+            c.median_stranded_iterations_per_stranded_node,
+            c.weighted_stranded_node_mean_stake,
+            c.weighted_stranded_node_median_stake)
+        dp.create_histogram_point("stranded_node_histogram", c.histogram)
+        dp.create_histogram_point("aggregate_hops_histogram",
+                                  self.hops_histogram)
+        dp.create_messages_point("egress_message_count",
+                                 self.egress_tracker.histogram, 0)
+        dp.create_messages_point("ingress_message_count",
+                                 self.ingress_tracker.histogram, 0)
+        dp.create_messages_point("prune_message_count",
+                                 self.prune_tracker.histogram, 0)
+        dp_queue.push_back(dp)

@@ -54,6 +54,32 @@ class Histogram:
                 log.error("histogram: entry %s outside [%s, %s]",
                           v, self.min_entry, self.max_entry)
 
+    def build_from_counts(self, upper_bound, lower_bound, num_buckets,
+                          value_counts):
+        """``build`` semantics fed pre-binned data: ``value_counts`` maps a
+        value -> how many times it occurred.  Avoids materializing raw-value
+        arrays when the source is an on-device histogram."""
+        self.min_entry = int(lower_bound)
+        self.max_entry = int(upper_bound)
+        self.num_buckets = int(num_buckets)
+        if upper_bound == lower_bound or lower_bound + 1 == upper_bound:
+            log.warning("histogram: max and min entries equal or off by 1")
+            self.bucket_range = 1
+        else:
+            self.bucket_range = max(
+                1, (self.max_entry - self.min_entry) // self.num_buckets)
+        self.entries = {b: 0 for b in range(self.num_buckets)}
+        for v, n in value_counts.items():
+            v = int(v)
+            if self.min_entry <= v <= self.max_entry:
+                bucket = (v - self.min_entry) // self.bucket_range
+                if bucket == self.num_buckets:
+                    bucket -= 1
+                self.entries[bucket] = self.entries.get(bucket, 0) + int(n)
+            else:
+                log.error("histogram: entry %s outside [%s, %s]",
+                          v, self.min_entry, self.max_entry)
+
     def build_from_map(self, num_buckets, counts, sorted_stakes, count_per_bucket):
         """counts: {pubkey: message count}; sorted_stakes: [(pubkey, stake)]
         descending by stake. Buckets are stake ranges; values are summed

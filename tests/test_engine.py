@@ -137,6 +137,7 @@ class TestOracleParity:
 
     N = 40
     ROUNDS = 25
+    PARAMS: dict = {"inbound_cap": 16}
 
     @pytest.fixture()
     def pair(self):
@@ -148,7 +149,7 @@ class TestOracleParity:
 
         tables = make_cluster_tables(stakes_np)
         params = EngineParams(num_nodes=n, probability_of_rotation=0.0,
-                              warm_up_rounds=0, inbound_cap=16)
+                              warm_up_rounds=0, **self.PARAMS)
         origin_idx = 0
         origins = jnp.asarray([origin_idx], jnp.int32)
         state = init_state(jax.random.PRNGKey(11), tables, origins, params)
@@ -214,6 +215,45 @@ class TestOracleParity:
                     continue
                 assert (origin_pk in peers[index.pubkeys[j]]) == bool(
                     pruned[i, slot]), (i, slot)
+
+
+class TestOracleParityWideFanout(TestOracleParity):
+    """push_fanout 18 exceeds the old hard inbound_cap=16; the auto-sized
+    ranking width (params.k_inbound = max(16, 2*fanout) = 36) must keep
+    received-cache scoring exact vs the oracle (received_cache.rs:83-98).
+    Inherits the bit-exact parity assertions."""
+
+    N = 40
+    ROUNDS = 22
+    PARAMS = {"push_fanout": 18, "active_set_size": 20, "inbound_cap": 0}
+
+
+class TestLargeCluster:
+    def test_20k_nodes_two_rounds(self):
+        """N=20,000 crosses the old 16,384 packing ceiling: the widened
+        pack base (engine/core.py _pack_base) must keep the round exact.
+        Invariant-level check only (oracle would be too slow here)."""
+        n = 20_000
+        rng = np.random.default_rng(11)
+        stakes = (np.exp(rng.normal(9.5, 2.0, n)).astype(np.int64) + 1) * 10**9
+        tables = make_cluster_tables(stakes)
+        params = EngineParams(num_nodes=n, warm_up_rounds=0)
+        origins = jnp.arange(1, dtype=jnp.int32)
+        state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+        active = np.asarray(state.active)
+        assert ((active >= 0) & (active <= n)).all()
+        state, rows = run_rounds(params, tables, origins, state, 2)
+        cov = np.asarray(rows["coverage"])
+        assert cov.shape == (2, 1) and (cov > 0.95).all(), cov
+        # received-cache rows stay sorted/dup-free through the widened keys
+        rc = np.asarray(state.rc_src)
+        members = rc < n
+        inner = members[..., 1:] & members[..., :-1]
+        assert (np.diff(rc, axis=-1)[inner] > 0).all()
+
+    def test_over_cap_raises(self):
+        with pytest.raises(ValueError, match="num_nodes"):
+            make_cluster_tables(np.ones(40_000, np.int64))
 
 
 class TestMultiChip:

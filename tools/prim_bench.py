@@ -1,6 +1,19 @@
-"""Primitive microbenchmarks with in-jit repetition (axon tunnel has ~70ms
-round-trip latency, so single-shot timing is meaningless).  Not shipped."""
-import sys
+"""TPU primitive microbenchmarks (differential in-jit repetition).
+
+The axon tunnel has ~70ms round-trip latency, so single-shot timing is
+meaningless; each op is scanned REPS times inside one jit with a data
+dependency threaded through a scalar to defeat CSE/hoisting, and the cost is
+(t[REPS+1] - t[1]) / REPS.
+
+Three suites (historically prim_bench{,2,3}.py; collapsed in round 5):
+  1 generic primitives (sorts, scatters, gathers, scans, matmuls)
+  2 the exact primitives of the sort-routed round (engine/core.py)
+  3 block gathers + compacted-F hop ops
+
+Usage: python tools/prim_bench.py [--suite 1|2|3|all] [--big]
+Not shipped as part of the package; dev-only.
+"""
+import argparse
 import time
 from functools import partial
 
@@ -13,8 +26,7 @@ REPS = 20
 
 
 def bench(name, make_fn, *args):
-    """make_fn(x, i) -> array; we scan it REPS times with i varying and a
-    data dependency threaded through a scalar to defeat CSE/hoisting."""
+    """make_fn(*args, i) -> array; scanned k times inside one jit."""
     try:
         @partial(jax.jit, static_argnums=(1,))
         def run(args, k):
@@ -27,12 +39,11 @@ def bench(name, make_fn, *args):
             c, _ = lax.scan(body, jnp.int32(0), jnp.arange(k))
             return c
         int(run(args, 1)); int(run(args, REPS + 1))
-        t1 = min(time.time() * 0 + _t(run, args, 1) for _ in range(2))
+        t1 = min(_t(run, args, 1) for _ in range(2))
         t2 = min(_t(run, args, REPS + 1) for _ in range(2))
-        dt = (t2 - t1) / REPS
-        print(f"{name:46s} {dt*1e3:9.3f} ms")
+        print(f"{name:52s} {(t2-t1)/REPS*1e3:9.3f} ms")
     except Exception as e:
-        print(f"{name:46s} FAILED: {type(e).__name__} {str(e)[:90]}")
+        print(f"{name:52s} FAILED: {type(e).__name__} {str(e)[:80]}")
 
 
 def _t(run, args, k):
@@ -41,8 +52,12 @@ def _t(run, args, k):
     return time.time() - t0
 
 
-def suite(O, N, S=12, D=64):
-    print(f"=== O={O} N={N} S={S} D={D}")
+# --------------------------------------------------------------------------
+# suite 1: generic primitives
+# --------------------------------------------------------------------------
+
+def suite1(O, N, S=12, D=64):
+    print(f"=== suite 1 (generic): O={O} N={N} S={S} D={D}")
     rng = np.random.default_rng(0)
     tgt = jnp.asarray(rng.integers(0, N, (O, N, S)), dtype=jnp.int32)
     dist = jnp.asarray(rng.integers(0, 15, (O, N)), dtype=jnp.int32)
@@ -116,8 +131,143 @@ def _bsearch(sorted_rows, queries):
     return lo
 
 
+# --------------------------------------------------------------------------
+# suite 2: the exact primitives of the sort-routed round
+# --------------------------------------------------------------------------
+
+def suite2(O, N, S=12, C=64, K=16, H=64):
+    print(f"=== suite 2 (round primitives): O={O} N={N} S={S} C={C} K={K}")
+    rng = np.random.default_rng(0)
+    NS = N * S
+    NK = N * K
+    tgt = jnp.asarray(rng.integers(0, N, (O, N, S)), dtype=jnp.int32)
+    dist = jnp.asarray(rng.integers(0, 15, (O, N)), dtype=jnp.int32)
+    idxK = jnp.asarray(rng.integers(0, N, (O, N, K)), dtype=jnp.int32)
+    table = jnp.asarray(rng.integers(0, 1 << 30, (N + 1,)), dtype=jnp.int32)
+    flatNK = jnp.asarray(rng.integers(0, N * K, (O, NK)), dtype=jnp.int32)
+    valsNK = jnp.asarray(rng.integers(0, 1 << 30, (O, NK)), dtype=jnp.int32)
+    key1 = jnp.sort(tgt.reshape(O, NS), axis=-1)
+    key2 = jnp.asarray(rng.integers(0, 1 << 30, (O, NS)), dtype=jnp.int32)
+    rows62 = jnp.asarray(rng.integers(0, 1 << 30, (O, N, C + K)), jnp.int32)
+    startpos = jnp.asarray(
+        np.sort(rng.integers(0, NS + N, (O, N)), axis=-1), jnp.int32)
+
+    bench("gather [O,N,K] from [N+1] table",
+          lambda ix, t, i: (t + i)[ix], idxK, table)
+    bench("gather [O,N] from [O,NS+N] (BFS extract)",
+          lambda sp, v, i: jnp.take_along_axis(
+              jnp.concatenate([v + i, v[:, :N]], axis=1), sp, axis=1),
+          startpos, key2)
+    bench("scatter [O,NK]->[O,N,K] i32",
+          lambda f, v, i: jnp.zeros((O, N * K), jnp.int32).at[
+              jnp.arange(O)[:, None], f].set(v + i, mode="drop"),
+          flatNK, valsNK)
+    bench("sort [O,NS] 2key+2payload",
+          lambda a, b, i: lax.sort((a, b + i, b, b), dimension=-1,
+                                   num_keys=2)[2], key1, key2)
+    bench("sort [O,NS] 1key+1payload",
+          lambda a, b, i: lax.sort((a + i, b), dimension=-1, num_keys=1)[1],
+          key1, key2)
+    bench("row sort [O,N,C+K] 1key+2payload",
+          lambda r, i: lax.sort((r + i, r, r), dimension=-1, num_keys=1)[1],
+          rows62)
+    bench("row sort [O,N,C+K] 4key",
+          lambda r, i: lax.sort((r + i, r, r, r), dimension=-1, num_keys=4)[3],
+          rows62)
+    bench("seg log-shift min [O,NS]",
+          lambda k1, v, i: _seg_min(k1, v + i), key1, key2)
+    bench("onehot hist [O,N]->[O,H]",
+          lambda d, i: jnp.sum(
+              ((d + i) % H)[:, :, None] == jnp.arange(H)[None, None, :],
+              axis=1, dtype=jnp.int32), dist)
+    bench("cumsum i64-as-2xi32 rows [O,N,C]",
+          lambda r, i: _cumsum64(r[..., :C] + i, r[..., :C]), rows62)
+    bench("while10 x elementwise [O,NS]",
+          lambda v, i: lax.while_loop(
+              lambda c: c[1] < 10,
+              lambda c: (jnp.minimum(c[0], c[0] * 3 + i), c[1] + 1),
+              (v, jnp.int32(0)))[0], key2)
+
+
+def _seg_min(sorted_keys, vals):
+    O, M = vals.shape
+    is_start = jnp.concatenate(
+        [jnp.ones((O, 1), bool),
+         sorted_keys[:, 1:] != sorted_keys[:, :-1]], axis=1)
+    x = vals
+    blocked = is_start
+    sh = 1
+    while sh < M:
+        prev = jnp.pad(x, ((0, 0), (sh, 0)), constant_values=1 << 30)[:, :M]
+        pb = jnp.pad(blocked, ((0, 0), (sh, 0)), constant_values=True)[:, :M]
+        x = jnp.where(blocked, x, jnp.minimum(x, prev))
+        blocked = blocked | pb
+        sh *= 2
+    return x
+
+
+def _cumsum64(hi, lo):
+    chi = jnp.cumsum(hi, axis=-1)
+    clo = jnp.cumsum(lo, axis=-1)
+    return chi + (clo >> 16)
+
+
+# --------------------------------------------------------------------------
+# suite 3: block gathers + compacted-F hop ops
+# --------------------------------------------------------------------------
+
+def suite3(O, N, F=6, K=16):
+    print(f"=== suite 3 (hop ops): O={O} N={N} F={F} K={K}")
+    rng = np.random.default_rng(0)
+    NF = N * F
+    M = NF + N
+    vals = jnp.asarray(rng.integers(0, 1 << 30, (O, M + K)), jnp.int32)
+    startpos = jnp.asarray(
+        np.sort(rng.integers(0, M, (O, N)), axis=-1), jnp.int32)
+    keyNF = jnp.sort(jnp.asarray(
+        rng.integers(0, 2 * N, (O, NF)), jnp.int32), axis=-1)
+
+    bench("block gather [O,N,K] windows from [O,M]",
+          lambda sp, v, i: jnp.take_along_axis(
+              v + i, jnp.minimum(
+                  sp[:, :, None] + jnp.arange(K)[None, None, :],
+                  M + K - 1).reshape(O, N * K), axis=1),
+          startpos, vals)
+    bench("block gather [O,N,4] windows",
+          lambda sp, v, i: jnp.take_along_axis(
+              v + i, jnp.minimum(
+                  sp[:, :, None] + jnp.arange(4)[None, None, :],
+                  M + K - 1).reshape(O, N * 4), axis=1),
+          startpos, vals)
+    bench("random gather [O,N] from [O,M]",
+          lambda sp, v, i: jnp.take_along_axis(v + i, sp, axis=1),
+          startpos, vals)
+    bench("sort [O,NF] 1key i32",
+          lambda a, i: lax.sort(((a + i) % (1 << 29),), dimension=-1,
+                                num_keys=1)[0], keyNF)
+    bench("sort [O,NF] 1key+1payload",
+          lambda a, i: lax.sort((a + i, a), dimension=-1, num_keys=1)[1],
+          keyNF)
+    bench("sort [O,NF+N] 1key+1payload",
+          lambda v, i: lax.sort((v[:, :M] + i, v[:, :M]), dimension=-1,
+                                num_keys=1)[1], vals)
+    bench("row sort+slice [O,N,12]->[O,N,6]",
+          lambda a, i: lax.sort(
+              ((a + i).reshape(O, N, 12), a.reshape(O, N, 12)),
+              dimension=-1, num_keys=1)[1][..., :6],
+          vals[:, :N * 12])
+
+
+SUITES = {"1": suite1, "2": suite2, "3": suite3}
+
+
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "big":
-        suite(32, 10000)
-    else:
-        suite(8, 2000)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--suite", default="all", choices=["1", "2", "3", "all"])
+    ap.add_argument("--big", action="store_true",
+                    help="O=32 N=10000 (target shapes) instead of O=8 N=2000")
+    args = ap.parse_args()
+    O, N = (32, 10000) if args.big else (8, 2000)
+    for name, fn in SUITES.items():
+        if args.suite in (name, "all"):
+            fn(O, N)

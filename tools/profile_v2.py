@@ -27,7 +27,7 @@ state = init_state(jax.random.PRNGKey(0), tables, origins, params)
 state = jax.block_until_ready(state)
 p = params
 S, F, Cc, K, H, T = (p.active_set_size, p.push_fanout, p.rc_slots,
-                     p.inbound_cap, p.hist_bins, p.rot_tries)
+                     p.k_inbound, p.hist_bins, p.rot_tries)
 NF, NK, NS = N * F, N * K, N * S
 
 
