@@ -29,13 +29,19 @@ _READABLE_VERSIONS = (1, 2)
 _SHAPE_FIELDS = ("num_nodes", "active_set_size", "rc_slots", "hist_bins")
 
 
-def save_state(path: str, state, params, config=None) -> None:
-    """Write SimState + EngineParams (+ optional Config) to one .npz."""
+def save_state(path: str, state, params, config=None,
+               iteration: int = 0) -> None:
+    """Write SimState + EngineParams (+ optional Config) to one .npz.
+
+    ``iteration`` records how many gossip rounds produced this state; a
+    resumed run continues from there (the engine's per-round RNG keys fold
+    in the absolute iteration number, so resumption is bit-exact)."""
     arrays = {f"state.{name}": np.asarray(getattr(state, name))
               for name in state._fields}
     meta = {
         "format_version": _FORMAT_VERSION,
         "params": dict(params._asdict()),
+        "iteration": int(iteration),
     }
     if config is not None:
         cfg = dict(vars(config))

@@ -134,10 +134,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-devices", type=int, default=0,
                    help="devices to shard origin batches over in "
                         "--all-origins mode (0 = all available)")
+    p.add_argument("--jax-profile", dest="jax_profile_dir", default="",
+                   metavar="DIR",
+                   help="tpu backend: capture a jax.profiler trace of the "
+                        "measured rounds into DIR (view with TensorBoard "
+                        "or xprof)")
     p.add_argument("--checkpoint-path", default="",
-                   help="save the final simulation state (SimState arrays + "
-                        "params) to this .npz; reload via "
-                        "gossip_sim_tpu.checkpoint.restore_sim_state")
+                   help="save the simulation state (SimState arrays + "
+                        "params) to this .npz after each measured block and "
+                        "at the end; resume with --resume")
+    p.add_argument("--resume", dest="resume_path", default="",
+                   help="tpu backend: load a --checkpoint-path .npz and "
+                        "continue from its recorded iteration (bit-exact; "
+                        "stats are recorded for the remaining rounds)")
     return p
 
 
@@ -174,7 +183,9 @@ def config_from_args(args) -> Config:
         all_origins=args.all_origins,
         origin_batch=args.origin_batch,
         checkpoint_path=args.checkpoint_path,
+        resume_path=args.resume_path,
         mesh_devices=args.mesh_devices,
+        jax_profile_dir=args.jax_profile_dir,
     )
 
 
@@ -262,6 +273,14 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
         cluster.send_prunes(origin_pubkey, nodes, config.prune_stake_threshold,
                             config.min_ingress_nodes, stakes)
         cluster.prune_connections(node_map, stakes)
+        if log.isEnabledFor(logging.DEBUG):
+            # the reference's debug-level dumps (gossip_main.rs:501-503,
+            # gossip.rs:365-431; workflow in README.md:274-354)
+            cluster.print_hops()
+            cluster.print_node_orders()
+            cluster.print_mst()
+            cluster.print_pushes()
+            cluster.print_prunes()
         cluster.chance_to_rotate(rng, nodes, config.gossip_active_set_size,
                                  stakes, config.probability_of_rotation)
         if it + 1 == config.warm_up_rounds:
@@ -316,35 +335,231 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
     origin_idx = index.index_of(origin_pubkey)
     origins = jnp.asarray([origin_idx], dtype=jnp.int32)
 
-    log.info("Simulating Gossip and setting active sets. Please wait.....")
-    state = init_state(jax.random.PRNGKey(config.seed), tables, origins, params)
-    log.info("Simulation Complete!")
+    start_iter = 0
+    if config.resume_path:
+        from .checkpoint import restore_sim_state
+        state, _, meta = restore_sim_state(config.resume_path, params, tables)
+        start_iter = int(meta.get("iteration", 0))
+        saved_cfg = meta.get("config", {})
+        # any field that changes round dynamics breaks the bit-exact-
+        # continuation contract; surface every drift, not just identity
+        for f in ("origin_rank", "seed", "num_synthetic_nodes",
+                  "gossip_push_fanout", "gossip_active_set_size",
+                  "probability_of_rotation", "prune_stake_threshold",
+                  "min_ingress_nodes", "warm_up_rounds",
+                  "fraction_to_fail", "when_to_fail"):
+            if f in saved_cfg and saved_cfg[f] != getattr(config, f):
+                log.warning("WARNING: resuming with %s=%s but checkpoint "
+                            "was written with %s=%s — continuation is NOT "
+                            "bit-exact with a full run under the new value",
+                            f, getattr(config, f), f, saved_cfg[f])
+        log.info("Resumed simulation state from %s at iteration %s",
+                 config.resume_path, start_iter)
+        if start_iter >= config.gossip_iterations:
+            # do NOT fall through: the save paths below would rewrite the
+            # checkpoint's iteration with the smaller --iterations while
+            # keeping the further-evolved state arrays
+            log.warning("WARNING: checkpoint already at iteration %s >= "
+                        "--iterations %s; nothing to run", start_iter,
+                        config.gossip_iterations)
+            return stakes
+    else:
+        log.info("Simulating Gossip and setting active sets. Please wait.....")
+        state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
+                           params)
+        log.info("Simulation Complete!")
 
     def _record_failed():
         failed_idx = np.nonzero(np.asarray(state.failed)[0])[0]
         stats.set_failed_nodes({index.pubkeys[i] for i in failed_idx})
 
-    def _save_checkpoint():
+    def _save_checkpoint(iteration):
         if config.checkpoint_path:
             from .checkpoint import save_state
-            save_state(config.checkpoint_path, state, params, config)
+            save_state(config.checkpoint_path, state, params, config,
+                       iteration=iteration)
+
+    if config.resume_path and 0 <= params.fail_at < start_iter:
+        _record_failed()
 
     warm = min(config.warm_up_rounds, config.gossip_iterations)
-    if warm > 0:
+    if start_iter < warm:
         # match the oracle loop's progress logs + influx config cadence
         # (gossip_main.rs:426-447) without harvesting warm-up detail
-        for it in range(0, warm, 10):
+        for it in range(start_iter, warm, 10):
             log.info("GOSSIP ITERATION: %s", it)
             _push_config_point(config, dp_queue, sim_iter, start_ts)
-        state, _ = run_rounds(params, tables, origins, state, warm)
-        if 0 <= params.fail_at < warm:
+        state, _ = run_rounds(params, tables, origins, state,
+                              warm - start_iter, start_it=start_iter)
+        if start_iter <= params.fail_at < warm:
             _record_failed()
+        _save_checkpoint(warm)
     measured = config.gossip_iterations - warm
     if measured <= 0:
-        _save_checkpoint()
+        _save_checkpoint(config.gossip_iterations)
         return stakes
 
     # Harvest measured rounds in blocks to bound host-side detail arrays.
+    import contextlib
+    profile_cm = (jax.profiler.trace(config.jax_profile_dir)
+                  if config.jax_profile_dir else contextlib.nullcontext())
+    block = 256
+    done = max(0, start_iter - warm)
+    with profile_cm:
+        while done < measured:
+            n_it = min(block, measured - done)
+            start_it = warm + done
+            state, rows = run_rounds(params, tables, origins, state, n_it,
+                                     start_it=start_it, detail=True)
+            rows = jax.tree_util.tree_map(np.asarray, rows)
+            _warn_shape_truncation(rows, params)
+            if (params.fail_at >= 0
+                    and start_it <= params.fail_at < start_it + n_it):
+                _record_failed()
+            for t in range(n_it):
+                it = start_it + t
+                if it % 10 == 0:
+                    log.info("GOSSIP ITERATION: %s", it)
+                    _push_config_point(config, dp_queue, sim_iter, start_ts)
+                _feed_measured_round(stats, rows, t, 0, it, config, index,
+                                     stakes, origin_pubkey, dp_queue,
+                                     sim_iter, start_ts)
+            done += n_it
+            _save_checkpoint(warm + done)
+    if config.jax_profile_dir:
+        log.info("jax.profiler trace written to %s", config.jax_profile_dir)
+
+    _feed_message_counters(stats, state, 0, index)
+    _save_checkpoint(config.gossip_iterations)
+    return stakes
+
+
+def _feed_measured_round(stats, rows, t, col, it, config, index, stakes,
+                         origin_pubkey, dp_queue, sim_iter, start_ts):
+    """Insert one measured round (origin column ``col`` of harvested rows)
+    into the stats layer — the reference's per-iteration stat block
+    (gossip_main.rs:480-563)."""
+    steady = it - config.warm_up_rounds
+    coverage = float(rows["coverage"][t, col])
+    if coverage < POOR_COVERAGE_THRESHOLD:
+        log.warning("WARNING: poor coverage for origin: %s, %s",
+                    origin_pubkey, coverage)
+    dist = rows["dist"][t, col]            # [N], -1 = unreached
+    hops = np.where(dist < 0, UNREACHED, dist.astype(np.uint64))
+    stranded_mask = rows["stranded_mask"][t, col]
+    stranded = [index.pubkeys[i] for i in np.nonzero(stranded_mask)[0]]
+    stats.insert_coverage(coverage)
+    stats.insert_hops_stat(hops.tolist())
+    stats.insert_stranded_nodes(stranded, stakes)
+    stats.insert_branching_factor(float(rows["branching"][t, col]))
+    rmr_result = (float(rows["rmr"][t, col]), int(rows["m"][t, col]),
+                  int(rows["n"][t, col]))
+    stats.insert_rmr(rmr_result[0])
+    _push_iteration_points(config, dp_queue, sim_iter, start_ts,
+                           stats, steady, coverage, rmr_result)
+
+
+def _feed_message_counters(stats, state, col, index):
+    """Message counters accumulate on-device across measured rounds; feed
+    the trackers once (equals the reference's per-round cumulative
+    updates)."""
+    n = len(index)
+    egress = np.asarray(state.egress_acc)[col]
+    ingress = np.asarray(state.ingress_acc)[col]
+    prunes = np.asarray(state.prune_acc)[col]
+    stats.update_message_counts(
+        {index.pubkeys[i]: int(egress[i]) for i in range(n)},
+        {index.pubkeys[i]: int(ingress[i]) for i in range(n)})
+    stats.update_prune_counts(
+        {index.pubkeys[i]: int(prunes[i]) for i in range(n)})
+
+
+def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
+                          stats_collection: GossipStatsCollection, dp_queue,
+                          start_ts: str):
+    """ORIGIN_RANK sweep as ONE origin-batched engine call (SURVEY.md §2.3
+    "batch parameter grids where shapes allow").
+
+    The serial path (gossip_main.rs:872-891) runs R full simulations; here
+    the R origins ride the engine's origin axis in a single init + scan.
+    Per-origin RNG streams fold the origin index exactly as a single-origin
+    run does (engine/core.py init_state), so each rank's statistics are
+    bit-identical to its serial run — tested in tests/test_cli.py."""
+    import jax
+    import jax.numpy as jnp
+
+    from .engine import (EngineParams, init_state, make_cluster_tables,
+                         run_rounds)
+
+    accounts, source_label = load_cluster_accounts(config, json_rpc_url)
+    if config.checkpoint_path or config.resume_path:
+        log.warning("WARNING: --checkpoint-path/--resume are not supported "
+                    "by the batched origin-rank sweep; ignoring")
+    index = NodeIndex.from_stakes(accounts)
+    stakes = dict(accounts)
+    N = len(index)
+    R = config.num_simulations
+    configs, origin_pks = [], []
+    for i in range(R):
+        c = config.stepped(origin_rank=origin_ranks[i])
+        if len(accounts) < c.origin_rank:
+            raise SystemExit(
+                f"ERROR: origin_rank larger than number of simulation "
+                f"nodes. nodes: {len(accounts)}, origin_rank: {c.origin_rank}")
+        configs.append(c)
+        origin_pks.append(
+            find_nth_largest_node(c.origin_rank, list(accounts.items()))[0])
+    origins = jnp.asarray([index.index_of(pk) for pk in origin_pks],
+                          dtype=jnp.int32)
+    log.info("##### BATCHED ORIGIN-RANK SWEEP: %s origins in one engine "
+             "call #####", R)
+
+    params = EngineParams(
+        num_nodes=N,
+        push_fanout=config.gossip_push_fanout,
+        active_set_size=config.gossip_active_set_size,
+        probability_of_rotation=config.probability_of_rotation,
+        prune_stake_threshold=config.prune_stake_threshold,
+        min_ingress_nodes=config.min_ingress_nodes,
+        warm_up_rounds=config.warm_up_rounds,
+    )
+    tables = make_cluster_tables(index.stakes.astype(np.int64))
+
+    stats_list = []
+    for i, c in enumerate(configs):
+        log.info("##### SIMULATION ITERATION: %s #####", i)
+        log.info("ORIGIN: %s", origin_pks[i])
+        stats = GossipStats()
+        stats.set_simulation_parameters(c)
+        stats.set_origin(origin_pks[i])
+        stats.initialize_message_stats(stakes)
+        stats.build_validator_stake_distribution_histogram(
+            VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS, stakes)
+        stats_list.append(stats)
+
+    if dp_queue is not None:
+        dp = InfluxDataPoint(start_ts, 0)
+        dp.create_test_type_point(
+            config.num_simulations, config.gossip_iterations,
+            config.warm_up_rounds, config.step_size, len(accounts),
+            config.probability_of_rotation, source_label,
+            str(float(origin_ranks[0])), config.test_type)
+        dp.create_validator_stake_distribution_histogram_point(
+            stats_list[0].get_validator_stake_distribution_histogram())
+        dp.set_start()
+        dp_queue.push_back(dp)
+
+    log.info("Simulating Gossip and setting active sets. Please wait.....")
+    state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
+                       params)
+    log.info("Simulation Complete!")
+
+    warm = min(config.warm_up_rounds, config.gossip_iterations)
+    if warm > 0:
+        for it in range(0, warm, 10):
+            log.info("GOSSIP ITERATION: %s", it)
+        state, _ = run_rounds(params, tables, origins, state, warm)
+    measured = config.gossip_iterations - warm
     block = 256
     done = 0
     while done < measured:
@@ -354,46 +569,22 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
                                  start_it=start_it, detail=True)
         rows = jax.tree_util.tree_map(np.asarray, rows)
         _warn_shape_truncation(rows, params)
-        if params.fail_at >= 0 and start_it <= params.fail_at < start_it + n_it:
-            _record_failed()
         for t in range(n_it):
             it = start_it + t
             if it % 10 == 0:
                 log.info("GOSSIP ITERATION: %s", it)
-                _push_config_point(config, dp_queue, sim_iter, start_ts)
-            steady = it - config.warm_up_rounds
-            coverage = float(rows["coverage"][t, 0])
-            if coverage < POOR_COVERAGE_THRESHOLD:
-                log.warning("WARNING: poor coverage for origin: %s, %s",
-                            origin_pubkey, coverage)
-            dist = rows["dist"][t, 0]            # [N], -1 = unreached
-            hops = np.where(dist < 0, UNREACHED, dist.astype(np.uint64))
-            stranded_mask = rows["stranded_mask"][t, 0]
-            stranded = [index.pubkeys[i] for i in np.nonzero(stranded_mask)[0]]
-            stats.insert_coverage(coverage)
-            stats.insert_hops_stat(hops.tolist())
-            stats.insert_stranded_nodes(stranded, stakes)
-            stats.insert_branching_factor(float(rows["branching"][t, 0]))
-            rmr_result = (float(rows["rmr"][t, 0]), int(rows["m"][t, 0]),
-                          int(rows["n"][t, 0]))
-            stats.insert_rmr(rmr_result[0])
-            _push_iteration_points(config, dp_queue, sim_iter, start_ts,
-                                   stats, steady, coverage, rmr_result)
+            for col in range(R):
+                if it % 10 == 0:
+                    _push_config_point(configs[col], dp_queue, col, start_ts)
+                _feed_measured_round(stats_list[col], rows, t, col, it,
+                                     configs[col], index, stakes,
+                                     origin_pks[col], dp_queue, col, start_ts)
         done += n_it
 
-    # Message counters accumulate on-device across measured rounds; feed the
-    # trackers once (equals the reference's per-round cumulative updates).
-    egress = np.asarray(state.egress_acc)[0]
-    ingress = np.asarray(state.ingress_acc)[0]
-    prunes = np.asarray(state.prune_acc)[0]
-    stats.update_message_counts(
-        {index.pubkeys[i]: int(egress[i]) for i in range(N)},
-        {index.pubkeys[i]: int(ingress[i]) for i in range(N)})
-    stats.update_prune_counts(
-        {index.pubkeys[i]: int(prunes[i]) for i in range(N)})
-
-    _save_checkpoint()
-    return stakes
+    for col in range(R):
+        _feed_message_counters(stats_list[col], state, col, index)
+        _finalize_sim_stats(configs[col], stats_list[col], stakes,
+                            stats_collection, dp_queue, col, start_ts)
 
 
 def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
@@ -630,30 +821,39 @@ def run_simulation(config: Config, json_rpc_url: str,
     stakes = runner(config, accounts, origin_pubkey, stats, dp_queue,
                     sim_iter, start_ts)
 
-    if not stats.is_empty():
-        stats.build_stranded_node_histogram(
-            config.gossip_iterations - config.warm_up_rounds, 0,
-            config.num_buckets_for_stranded_node_hist)
-        if config.test_type == Testing.FAIL_NODES:
-            stats.build_aggregate_hops_stats_histogram(
-                int(AGGREGATE_HOPS_FAIL_NODES_HISTOGRAM_UPPER_BOUND
-                    * (1.0 + config.fraction_to_fail)),
-                0, config.num_buckets_for_hops_stats_hist)
-        elif config.test_type == Testing.MIN_INGRESS_NODES:
-            stats.build_aggregate_hops_stats_histogram(
-                AGGREGATE_HOPS_MIN_INGRESS_NODES_HISTOGRAM_UPPER_BOUND,
-                0, config.num_buckets_for_hops_stats_hist)
-        else:
-            stats.build_aggregate_hops_stats_histogram(
-                STANDARD_HISTOGRAM_UPPER_BOUND, 0,
-                config.num_buckets_for_hops_stats_hist)
-        stats.build_message_histograms(
-            config.num_buckets_for_message_hist, True, stakes)
-        stats.build_prune_histogram(
-            config.num_buckets_for_message_hist, True, stakes)
-        stats.run_all_calculations()
-        stats_collection.push(stats)
-        _push_end_of_sim_points(config, dp_queue, sim_iter, start_ts, stats)
+    _finalize_sim_stats(config, stats, stakes, stats_collection, dp_queue,
+                        sim_iter, start_ts)
+
+
+def _finalize_sim_stats(config, stats, stakes, stats_collection, dp_queue,
+                        sim_iter, start_ts):
+    """End-of-simulation histograms + calculations + collection push
+    (gossip_main.rs:567-645)."""
+    if stats.is_empty():
+        return
+    stats.build_stranded_node_histogram(
+        config.gossip_iterations - config.warm_up_rounds, 0,
+        config.num_buckets_for_stranded_node_hist)
+    if config.test_type == Testing.FAIL_NODES:
+        stats.build_aggregate_hops_stats_histogram(
+            int(AGGREGATE_HOPS_FAIL_NODES_HISTOGRAM_UPPER_BOUND
+                * (1.0 + config.fraction_to_fail)),
+            0, config.num_buckets_for_hops_stats_hist)
+    elif config.test_type == Testing.MIN_INGRESS_NODES:
+        stats.build_aggregate_hops_stats_histogram(
+            AGGREGATE_HOPS_MIN_INGRESS_NODES_HISTOGRAM_UPPER_BOUND,
+            0, config.num_buckets_for_hops_stats_hist)
+    else:
+        stats.build_aggregate_hops_stats_histogram(
+            STANDARD_HISTOGRAM_UPPER_BOUND, 0,
+            config.num_buckets_for_hops_stats_hist)
+    stats.build_message_histograms(
+        config.num_buckets_for_message_hist, True, stakes)
+    stats.build_prune_histogram(
+        config.num_buckets_for_message_hist, True, stakes)
+    stats.run_all_calculations()
+    stats_collection.push(stats)
+    _push_end_of_sim_points(config, dp_queue, sim_iter, start_ts, stats)
 
 
 # --------------------------------------------------------------------------
@@ -664,6 +864,13 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
                     collection: GossipStatsCollection, dp_queue,
                     start_ts: str):
     tt = config.test_type
+    if (tt == Testing.ORIGIN_RANK and config.backend == "tpu"
+            and config.num_simulations > 1):
+        # shapes are origin-invariant, so the whole sweep batches onto the
+        # engine's origin axis (one init + one scan instead of R runs)
+        run_origin_rank_sweep(config, json_rpc_url, origin_ranks,
+                              collection, dp_queue, start_ts)
+        return
     for i in range(config.num_simulations):
         if tt == Testing.ACTIVE_SET_SIZE:
             v = config.gossip_active_set_size + i * config.step_size.as_int()

@@ -98,8 +98,11 @@ class Config:
     num_synthetic_nodes: int = 0    # >0: synthetic cluster instead of file/RPC
     all_origins: bool = False       # vmap the origin axis (north-star mode)
     origin_batch: int = 0           # origins per device batch (0 = auto)
-    checkpoint_path: str = ""       # save/resume sim state
+    checkpoint_path: str = ""       # save sim state (periodically + at end)
+    resume_path: str = ""           # load sim state and continue
     mesh_devices: int = 0           # 0 = all available devices
+    jax_profile_dir: str = ""       # capture jax.profiler trace of measured
+                                    # rounds (tpu backend)
 
     def stepped(self, **kw) -> "Config":
         return replace(self, **kw)

@@ -49,3 +49,36 @@ def get_influx_url(url: str) -> str:
     """Resolve Influx URL monikers (reference: lib.rs:96-102)."""
     return {"i": INFLUX_INTERNAL_METRICS, "internal-metrics": INFLUX_INTERNAL_METRICS,
             "l": INFLUX_LOCALHOST, "localhost": INFLUX_LOCALHOST}.get(url, url)
+
+
+class Stats:
+    """f64 stat display wrapper (reference: lib.rs:58-64,76-86):
+    ``Stats.mean(x)`` formats as "Mean: {x:.6}" etc."""
+
+    def __init__(self, kind: str, value: float):
+        self.kind = kind
+        self.value = value
+
+    mean = classmethod(lambda cls, v: cls("Mean", v))
+    median = classmethod(lambda cls, v: cls("Median", v))
+    max = classmethod(lambda cls, v: cls("Max", v))
+    min = classmethod(lambda cls, v: cls("Min", v))
+
+    def __str__(self):
+        return f"{self.kind}: {self.value:.6f}"
+
+    def __eq__(self, other):
+        return (isinstance(other, type(self)) and self.kind == other.kind
+                and self.value == other.value)
+
+
+class HopsStats(Stats):
+    """Hop stat display wrapper (reference: lib.rs:50-56,66-74): means get
+    6 decimals, medians 2, max/min print as integers."""
+
+    def __str__(self):
+        if self.kind == "Mean":
+            return f"Mean: {self.value:.6f}"
+        if self.kind == "Median":
+            return f"Median: {self.value:.2f}"
+        return f"{self.kind}: {int(self.value)}"

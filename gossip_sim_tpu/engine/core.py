@@ -305,7 +305,8 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
 # --------------------------------------------------------------------------
 
 def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
-               state: SimState, it: jax.Array, detail: bool = False):
+               state: SimState, it: jax.Array, detail: bool = False,
+               edge_detail: bool = False):
     """One full gossip round for all O origin-sims.  Returns (state, rows)."""
     p = params
     N, S, F, C, K, H = (p.num_nodes, p.active_set_size, p.push_fanout,
@@ -711,6 +712,13 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     if detail:
         rows["stranded_mask"] = stranded
         rows["dist"] = jnp.where(reached, dist, -1).astype(jnp.int32)
+    if edge_detail:
+        # per-edge hop matrix: the engine equivalent of the reference's
+        # ``orders`` debug dump (gossip.rs:374-390) — edge (src -> tgt)
+        # delivered at hop dist[src]+1; -1 marks unsent fanout slots.
+        rows["push_targets"] = jnp.where(delivered, tgt, -1)
+        rows["edge_hops"] = jnp.where(
+            delivered, jnp.broadcast_to(hop1[:, :, None], (O, N, F)), -1)
     return new_state, rows
 
 
@@ -718,19 +726,22 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
 # multi-round runner
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnums=(0, 4, 5), donate_argnums=(3,))
-def _run(params, tables, origins, state, num_iters, detail, start_it):
+@partial(jax.jit, static_argnums=(0, 4, 5, 6), donate_argnums=(3,))
+def _run(params, tables, origins, state, num_iters, detail, edge_detail,
+         start_it):
     def step(st, it):
-        return round_step(params, tables, origins, st, it, detail=detail)
+        return round_step(params, tables, origins, st, it, detail=detail,
+                          edge_detail=edge_detail)
     its = jnp.arange(num_iters) + start_it
     return lax.scan(step, state, its)
 
 
 def run_rounds(params: EngineParams, tables: ClusterTables, origins: jax.Array,
                state: SimState, num_iters: int, start_it=0,
-               detail: bool = False):
+               detail: bool = False, edge_detail: bool = False):
     """Run ``num_iters`` rounds under one jitted scan (the reference's hot
     loop, gossip_main.rs:425-565).  Returns (state, rows-of-arrays with a
-    leading [num_iters] axis)."""
+    leading [num_iters] axis).  ``edge_detail`` additionally exports the
+    per-edge (src, fanout-slot) -> (target, hop) matrices per round."""
     return _run(params, tables, origins, state, int(num_iters), bool(detail),
-                jnp.asarray(start_it, jnp.int32))
+                bool(edge_detail), jnp.asarray(start_it, jnp.int32))

@@ -14,16 +14,73 @@ same RNG stream.
 
 from __future__ import annotations
 
+import hashlib
+import math
+
 from ..constants import NUM_PUSH_ACTIVE_SET_ENTRIES
 from ..identity import get_stake_bucket
 from .weighted_shuffle import WeightedShuffle
 
 
-class PushActiveSetEntry:
-    """Insertion-ordered map: peer pubkey -> set of pruned origins."""
+class BloomFilter:
+    """Statistical stand-in for the reference's per-peer prune bloom
+    (``Bloom::random(cluster_size, 0.1, 32768)``, push_active_set.rs:122-123).
 
-    def __init__(self):
-        self.peers = {}  # Pubkey -> set(Pubkey); python dicts preserve insertion order
+    Same geometry — ``num_bits = -n ln(p) / ln(2)^2`` capped at 32768,
+    ``num_keys = round(m/n * ln 2)`` — with Kirsch-Mitzenmacher double
+    hashing over the two independent 32-bit halves of a blake2b-64 digest
+    instead of the reference's keyed FNV: *false-positive rate* parity, not
+    bit parity.  Used by the bloom-fidelity experiment
+    (tools/bloom_divergence.py) to measure the over-prune effect the
+    engine's exact masks deliberately omit."""
+
+    __slots__ = ("m", "k", "salts", "bits")
+
+    def __init__(self, num_items, rng=None, false_rate=0.1, max_bits=32768,
+                 salt_seed=None):
+        n = max(1, int(num_items))
+        m = int(math.ceil(n * abs(math.log(false_rate)) / (math.log(2) ** 2)))
+        self.m = max(1, min(max_bits, m))
+        self.k = max(1, round(self.m / n * math.log(2)))
+        if salt_seed is not None:
+            # deterministic salts that do NOT consume the simulation RNG —
+            # keeps exact-mode and bloom-mode runs on identical RNG streams
+            # so a comparison isolates genuine false-positive effects
+            d = hashlib.blake2b(salt_seed.to_bytes(8, "little"),
+                                digest_size=4 * self.k).digest()
+            self.salts = [int.from_bytes(d[4 * i:4 * i + 4], "little")
+                          for i in range(self.k)]
+        else:
+            # keyed hashes drawn from the sim's RNG stream (reference-like:
+            # Bloom::random draws keys from the thread rng)
+            self.salts = [rng.gen_range_u64(0, 1 << 32)
+                          for _ in range(self.k)]
+        self.bits = 0
+
+    def _positions(self, item):
+        raw = item.raw if hasattr(item, "raw") else bytes(item)
+        d = int.from_bytes(hashlib.blake2b(raw, digest_size=8).digest(),
+                           "little")
+        h1 = d & 0xFFFFFFFF
+        h2 = (d >> 32) | 1
+        return [(h1 + s * h2) % self.m for s in self.salts]
+
+    def add(self, item):
+        for p in self._positions(item):
+            self.bits |= 1 << p
+
+    def __contains__(self, item):
+        return all(self.bits >> p & 1 for p in self._positions(item))
+
+
+class PushActiveSetEntry:
+    """Insertion-ordered map: peer pubkey -> pruned-origin filter (an exact
+    set by default; a ``BloomFilter`` in bloom-fidelity mode)."""
+
+    def __init__(self, filter_factory=None):
+        self.peers = {}  # Pubkey -> filter; python dicts preserve insertion order
+        # filter_factory(peer, rng) -> filter pre-seeded with peer's own key
+        self.filter_factory = filter_factory
 
     def __len__(self):
         return len(self.peers)
@@ -56,17 +113,29 @@ class PushActiveSetEntry:
             node = nodes[idx]
             if node in self.peers:
                 continue
-            self.peers[node] = {node}  # self-seed: never push origin==peer to peer
+            # self-seed: never push origin==peer to peer
+            # (push_active_set.rs:179)
+            if self.filter_factory is None:
+                self.peers[node] = {node}
+            else:
+                f = self.filter_factory(node, rng)
+                f.add(node)
+                self.peers[node] = f
         while len(self.peers) > size:
             oldest = next(iter(self.peers))
             del self.peers[oldest]
 
 
 class PushActiveSet:
-    """25 stake-bucket entries (push_active_set.rs:24-119)."""
+    """25 stake-bucket entries (push_active_set.rs:24-119).
 
-    def __init__(self):
-        self.entries = [PushActiveSetEntry() for _ in range(NUM_PUSH_ACTIVE_SET_ENTRIES)]
+    ``filter_factory``: None = exact prune sets (the default, documented
+    divergence); pass ``lambda peer, rng: BloomFilter(cluster_size, rng)``
+    for reference-geometry bloom fidelity."""
+
+    def __init__(self, filter_factory=None):
+        self.entries = [PushActiveSetEntry(filter_factory)
+                        for _ in range(NUM_PUSH_ACTIVE_SET_ENTRIES)]
 
     def _entry(self, stake):
         return self.entries[get_stake_bucket(stake)]

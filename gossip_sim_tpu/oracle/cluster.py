@@ -22,6 +22,7 @@ unreproducible and are not carried forward.
 
 from __future__ import annotations
 
+import logging
 from collections import deque
 
 from ..constants import CRDS_UNIQUE_PUBKEY_CAPACITY, UNREACHED
@@ -29,14 +30,18 @@ from .active_set import PushActiveSet
 from .received_cache import ReceivedCache
 from .rmr import RelativeMessageRedundancy
 
+log = logging.getLogger(__name__)
+
 
 class Node:
     """Per-validator state (gossip.rs:774-856)."""
 
-    def __init__(self, pubkey, stake):
+    def __init__(self, pubkey, stake, filter_factory=None):
         self.pubkey = pubkey
         self.stake = stake
-        self.active_set = PushActiveSet()
+        # filter_factory: None = exact prune sets; see PushActiveSet for the
+        # bloom-fidelity mode (tools/bloom_divergence.py)
+        self.active_set = PushActiveSet(filter_factory)
         self.received_cache = ReceivedCache(2 * CRDS_UNIQUE_PUBKEY_CAPACITY)
         self.failed = False
 
@@ -220,6 +225,46 @@ class Cluster:
                   self.prune_messages_sent):
             for k in d:
                 d[k] = 0
+
+    # -- debug dumps (gossip.rs:365-431; the per-edge debug workflow of
+    # README.md:274-354) ------------------------------------------------------
+
+    def print_hops(self):
+        log.debug("DISTANCES FROM ORIGIN")
+        for pubkey, hops in self.distances.items():
+            log.debug("dest node, hops: (%s, %s)", pubkey, hops)
+
+    def print_node_orders(self):
+        """A => {B => 4}: A received a message in 4 hops through B
+        (gossip.rs:374-390)."""
+        log.debug("NODE ORDERS")
+        for recv_pubkey, neighbors in self.orders.items():
+            log.debug("----- dest node, num_inbound: %s, %s -----",
+                      recv_pubkey, len(neighbors))
+            for peer, order in neighbors.items():
+                log.debug("neighbor pubkey, order: %s, %s", peer, order)
+
+    def print_mst(self):
+        log.debug("MST: ")
+        for src, dests in self.mst.items():
+            log.debug("##### src: %s #####", src)
+            for dest in dests:
+                log.debug("dest: %s", dest)
+
+    def print_prunes(self):
+        log.debug("PRUNES: ")
+        for pruner, prunes in self.prunes.items():
+            log.debug("--------- Pruner: %s ---------", pruner)
+            for prunee in prunes:
+                log.debug("Prunee: %s", prunee)
+
+    def print_pushes(self):
+        log.debug("PUSHES: ")
+        for src, dests in self.pushes.items():
+            log.debug("************* SRC: %s, # %s *************",
+                      src, len(dests))
+            for dst in dests:
+                log.debug("Dest: %s", dst)
 
 
 def make_cluster_nodes(accounts, filter_zero_staked=False):

@@ -58,7 +58,11 @@ class HistogramHopsStat:
 
 class AllOriginsStats:
     """Aggregates engine rows + on-device accumulators across origin batches
-    into reference-shaped statistics."""
+    into reference-shaped statistics.
+
+    Per-point series stay as numpy chunks (measured_points reaches ~1e7 at
+    the 10k-origins x 1000-iterations target; boxed-float lists would cost
+    GBs); finalize() computes the StatCollection summaries vectorized."""
 
     def __init__(self, index, hist_bins: int):
         self.index = index               # NodeIndex (pubkeys <-> stakes)
@@ -67,7 +71,8 @@ class AllOriginsStats:
         self.coverage_stats = StatCollection("Coverage")
         self.rmr_stats = StatCollection("RMR")
         self.branching_stats = StatCollection("Outbound Branching Factor")
-        self.ldh_values = []             # per (measured round, origin) max hop
+        self._chunks = {"coverage": [], "rmr": [], "branching": [],
+                        "ldh": []}   # per-batch [measured*O] arrays
         self.hops_hist = np.zeros(hist_bins, np.int64)
         self.stranded_counts = np.zeros(self.N, np.int64)
         self.egress = np.zeros(self.N, np.int64)
@@ -93,19 +98,17 @@ class AllOriginsStats:
         SimState accumulators (already warm-up-gated on device)."""
         cov = np.asarray(rows["coverage"])[warm_up_rounds:]
         if cov.size:
-            # bulk-extend (C speed) — measured_points reaches ~1e7 at the
-            # 10k-origins x 1000-iterations target, so no per-value pushes
-            self.coverage_stats.collection.extend(
-                cov.ravel().astype(float).tolist())
-            self.rmr_stats.collection.extend(
+            self._chunks["coverage"].append(
+                cov.ravel().astype(np.float64))
+            self._chunks["rmr"].append(
                 np.asarray(rows["rmr"])[warm_up_rounds:]
-                .ravel().astype(float).tolist())
-            self.branching_stats.collection.extend(
+                .ravel().astype(np.float64))
+            self._chunks["branching"].append(
                 np.asarray(rows["branching"])[warm_up_rounds:]
-                .ravel().astype(float).tolist())
-            self.ldh_values.extend(
+                .ravel().astype(np.float64))
+            self._chunks["ldh"].append(
                 np.asarray(rows["hop_max"])[warm_up_rounds:]
-                .ravel().tolist())
+                .ravel().astype(np.int64))
         self.hops_hist += np.asarray(state.hops_hist_acc,
                                      dtype=np.int64).sum(axis=0)
         self.stranded_counts += np.asarray(state.stranded_acc,
@@ -120,13 +123,44 @@ class AllOriginsStats:
 
     # -- end-of-run -------------------------------------------------------
 
+    @staticmethod
+    def _fill_stat_collection(sc, arr):
+        """Vectorized StatCollection summary (collections.py semantics:
+        mean/median with two-middle averaging/max/min)."""
+        if arr.size == 0:
+            sc.mean = sc.median = float("nan")
+            sc.max = sc.min = 0.0
+            return
+        sc.mean = float(arr.mean())
+        sc.median = float(np.median(arr))
+        sc.max = float(arr.max())
+        sc.min = float(arr.min())
+
     def finalize(self, config):
-        self.coverage_stats.calculate_stats()
-        self.rmr_stats.calculate_stats()
-        self.branching_stats.calculate_stats()
-        hstat = HistogramHopsStat(self.hops_hist)
-        self.aggregate_hops = hstat
-        self.ldh_stats = HopsStat(self.ldh_values)
+        cov = np.concatenate(self._chunks["coverage"]) if \
+            self._chunks["coverage"] else np.empty(0)
+        self._fill_stat_collection(self.coverage_stats, cov)
+        self._fill_stat_collection(
+            self.rmr_stats,
+            np.concatenate(self._chunks["rmr"]) if self._chunks["rmr"]
+            else np.empty(0))
+        self._fill_stat_collection(
+            self.branching_stats,
+            np.concatenate(self._chunks["branching"])
+            if self._chunks["branching"] else np.empty(0))
+        self.aggregate_hops = HistogramHopsStat(self.hops_hist)
+        # LDH = HopsStat over per-round maxima (gossip_stats.rs:196-210):
+        # filter 0 (rounds where nobody beyond the origin was reached)
+        ldh = (np.concatenate(self._chunks["ldh"])
+               if self._chunks["ldh"] else np.empty(0, np.int64))
+        ldh = ldh[ldh > 0]
+        s = HopsStat()
+        if ldh.size:
+            s.mean = float(ldh.mean())
+            s.median = float(np.median(ldh))
+            s.max = int(ldh.max())
+            s.min = int(ldh.min())
+        self.ldh_stats = s
 
         # Stranded collection from the per-node strand counts; mirrors
         # insert_nodes called once per (origin, measured round)

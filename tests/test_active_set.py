@@ -89,3 +89,23 @@ def test_push_active_set_entry():
                                  nodes[7], nodes[1], nodes[13]]
     entry.rotate(rng, 4, nodes, weights)
     assert list(entry.peers) == [nodes[5], nodes[7], nodes[1], nodes[13]]
+
+
+def test_bloom_filter_geometry_and_fp_rate():
+    """Reference bloom geometry (push_active_set.rs:122-123): at n items the
+    false-positive rate is ~0.1; no false negatives ever."""
+    from gossip_sim_tpu.oracle.active_set import BloomFilter
+
+    rng = ChaChaRng.from_seed_byte(7)
+    n = 500
+    bf = BloomFilter(n, rng)
+    members = [pubkey_new_unique() for _ in range(n)]
+    probes = [pubkey_new_unique() for _ in range(4000)]
+    for m in members:
+        bf.add(m)
+    assert all(m in bf for m in members), "no false negatives"
+    fp = sum(p in bf for p in probes) / len(probes)
+    assert 0.04 < fp < 0.2, f"fp rate {fp} far from the 0.1 design point"
+    # capped at 32768 bits like the reference
+    big = BloomFilter(100_000, rng)
+    assert big.m == 32768

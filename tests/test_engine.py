@@ -217,6 +217,28 @@ class TestOracleParity:
                     pruned[i, slot]), (i, slot)
 
 
+class TestEdgeDetail:
+    def test_edge_matrix_consistent_with_distances(self):
+        """edge_detail exports the per-edge hop matrix (the engine's
+        equivalent of the reference's orders dump, gossip.rs:374-390):
+        every delivered edge (src -> tgt) carries hop dist[src] + 1."""
+        _, tables, params, origins, state = _init(60, n_origins=1,
+                                                  warm_up_rounds=0)
+        state, rows = run_rounds(params, tables, origins, state, 3,
+                                 detail=True, edge_detail=True)
+        dist = np.asarray(rows["dist"])[:, 0]           # [r, N]
+        tg = np.asarray(rows["push_targets"])[:, 0]     # [r, N, F]
+        eh = np.asarray(rows["edge_hops"])[:, 0]
+        for r in range(3):
+            sent = tg[r] >= 0
+            src_hop = np.broadcast_to(dist[r][:, None] + 1, sent.shape)
+            np.testing.assert_array_equal(eh[r][sent], src_hop[sent])
+            # delivered targets are reached at <= the edge's hop
+            t_dist = dist[r][tg[r][sent]]
+            assert (t_dist >= 0).all()
+            assert (t_dist <= eh[r][sent]).all()
+
+
 class TestOracleParityWideFanout(TestOracleParity):
     """push_fanout 18 exceeds the old hard inbound_cap=16; the auto-sized
     ranking width (params.k_inbound = max(16, 2*fanout) = 36) must keep

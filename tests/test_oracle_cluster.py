@@ -158,3 +158,31 @@ def test_fail_nodes():
         if nd.failed:
             assert cluster.distances[nd.pubkey] == UNREACHED
             assert nd.pubkey not in cluster.stranded_nodes()
+
+
+def test_debug_dumps(caplog):
+    """The reference's debug-level dumps (gossip.rs:365-431): hops, node
+    orders, MST, pushes, prunes all emit under DEBUG."""
+    import logging
+
+    nodes, stakes, origin, rng = make_seeded_cluster()
+    init_gossip(rng, nodes, stakes, 12)
+    cluster = Cluster(2)
+    node_map = {nd.pubkey: nd for nd in nodes}
+    cluster.run_gossip(origin, stakes, node_map)
+    cluster.consume_messages(origin, nodes)
+    cluster.send_prunes(origin, nodes, 0.15, 2, stakes)
+    with caplog.at_level(logging.DEBUG,
+                         logger="gossip_sim_tpu.oracle.cluster"):
+        cluster.print_hops()
+        cluster.print_node_orders()
+        cluster.print_mst()
+        cluster.print_pushes()
+        cluster.print_prunes()
+    text = caplog.text
+    for banner in ("DISTANCES FROM ORIGIN", "NODE ORDERS", "MST:",
+                   "PUSHES:", "PRUNES:"):
+        assert banner in text
+    # every non-origin reached node appears in the orders dump
+    n_dests = sum(1 for pk in cluster.orders if pk != origin)
+    assert text.count("----- dest node, num_inbound:") == n_dests

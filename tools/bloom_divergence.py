@@ -1,0 +1,135 @@
+"""Measure the bloom false-positive divergence (exact masks vs 0.1-fp blooms).
+
+The reference filters push targets through per-peer bloom filters with a 10%
+false-positive rate (push_active_set.rs:122-123), so it occasionally
+*over-prunes*: a peer is skipped for an origin nobody ever pruned.  Both of
+this framework's backends use exact prune state instead (documented
+divergence).  This experiment quantifies what that omission changes, by
+running the CPU oracle twice on the same cluster — exact sets vs
+reference-geometry blooms (oracle/active_set.py BloomFilter) — and comparing
+coverage / RMR / prune volume / stranded counts.
+
+Usage: python tools/bloom_divergence.py [--num-nodes 2000] [--iterations 100]
+       [--warm-up 20] [--seed 42] [--json out.json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_sim_tpu.identity import reset_unique_pubkeys
+from gossip_sim_tpu.ingest import synthetic_accounts
+from gossip_sim_tpu.oracle.active_set import BloomFilter, PushActiveSet
+from gossip_sim_tpu.oracle.cluster import Cluster, Node
+from gossip_sim_tpu.oracle.rustrng import ChaChaRng
+
+
+class CountingBloom:
+    """BloomFilter plus an exact shadow set: counts probes where the bloom
+    answers True for an item never added (a genuine false positive — the
+    over-prune event the reference's 0.1-fp blooms can produce)."""
+
+    def __init__(self, inner, stats):
+        self.inner = inner
+        self.shadow = set()
+        self.stats = stats
+
+    def add(self, item):
+        self.inner.add(item)
+        self.shadow.add(item)
+
+    def __contains__(self, item):
+        hit = item in self.inner
+        self.stats["probes"] += 1
+        if hit and item not in self.shadow:
+            self.stats["false_positives"] += 1
+        return hit
+
+
+def run_mode(accounts, mode, args):
+    rng = ChaChaRng.from_seed_byte(args.seed % 256)
+    n = len(accounts)
+    fp_stats = {"probes": 0, "false_positives": 0}
+    counter = [0]
+
+    def bloom_factory(peer, r):
+        # salt_seed (not the sim rng) keeps both modes on the identical RNG
+        # stream; any remaining divergence is caused by fp events alone
+        counter[0] += 1
+        return CountingBloom(BloomFilter(n, salt_seed=counter[0]), fp_stats)
+
+    factory = None if mode == "exact" else bloom_factory
+    nodes = [Node(pk, st, factory) for pk, st in accounts.items()]
+    stakes = dict(accounts)
+    node_map = {nd.pubkey: nd for nd in nodes}
+    origin = max(accounts.items(), key=lambda kv: kv[1])[0]
+    for nd in nodes:
+        nd.initialize_gossip(rng, stakes, 12)
+
+    cluster = Cluster(6)
+    cov, rmr, stranded, prunes = [], [], [], 0
+    t0 = time.time()
+    for it in range(args.iterations):
+        cluster.run_gossip(origin, stakes, node_map)
+        cluster.consume_messages(origin, nodes)
+        cluster.send_prunes(origin, nodes, 0.15, 2, stakes)
+        cluster.prune_connections(node_map, stakes)
+        cluster.chance_to_rotate(rng, nodes, 12, stakes, 1 / 75)
+        if it >= args.warm_up:
+            c, _ = cluster.coverage(stakes)
+            cov.append(c)
+            rmr.append(cluster.relative_message_redundancy()[0])
+            stranded.append(len(cluster.stranded_nodes()))
+            prunes += sum(len(p) for p in cluster.prunes.values())
+    dt = time.time() - t0
+    m = len(cov)
+    return {
+        "mode": mode,
+        "coverage_mean": sum(cov) / m,
+        "coverage_min": min(cov),
+        "rmr_mean": sum(rmr) / m,
+        "stranded_total": sum(stranded),
+        "prune_messages": prunes,
+        "measured_rounds": m,
+        "elapsed_s": round(dt, 1),
+        "bloom_probes": fp_stats["probes"],
+        "bloom_false_positives": fp_stats["false_positives"],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-nodes", type=int, default=2000)
+    ap.add_argument("--iterations", type=int, default=100)
+    ap.add_argument("--warm-up", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--json", default="")
+    args = ap.parse_args()
+
+    reset_unique_pubkeys()
+    rng = ChaChaRng.from_seed_byte(args.seed % 256)
+    accounts = synthetic_accounts(args.num_nodes, rng)
+
+    results = [run_mode(accounts, m, args) for m in ("exact", "bloom")]
+    ex, bl = results
+    delta = {
+        "coverage_mean_delta": bl["coverage_mean"] - ex["coverage_mean"],
+        "rmr_mean_delta": bl["rmr_mean"] - ex["rmr_mean"],
+        "stranded_total_delta": bl["stranded_total"] - ex["stranded_total"],
+        "prune_messages_delta": bl["prune_messages"] - ex["prune_messages"],
+    }
+    out = {"num_nodes": args.num_nodes, "iterations": args.iterations,
+           "warm_up": args.warm_up, "seed": args.seed,
+           "exact": ex, "bloom": bl, "delta": delta}
+    print(json.dumps(out, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
