@@ -13,20 +13,40 @@ from __future__ import annotations
 
 import json
 import logging
+import os
+import tempfile
 
 import numpy as np
 
+from .engine.params import EngineParams
+
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 2
+_FORMAT_VERSION = 3
 # v1 checkpoints predate the tfail/rc_shi/rc_slo SimState fields; all three
 # are derivable from active/failed/rc_src plus the cluster stake table, so
 # v1 files remain loadable when ``tables`` is passed to restore_sim_state.
-_READABLE_VERSIONS = (1, 2)
+# v2 predates the fault-injection subsystem (faults.py); v3 adds the
+# ``impair`` meta block recording the impairment configuration the state
+# evolved under.  Because every impairment decision is a stateless counter
+# hash of (impair_seed, iteration, node ids), no extra *array* state is
+# needed for bit-exact resumption mid-churn — the ``failed`` mask (already
+# stored) plus the recorded knobs fully determine the continuation.  v2
+# files backfill an all-off impair block on load.
+_READABLE_VERSIONS = (1, 2, 3)
 
 # EngineParams fields that define array shapes; a mismatch makes the stored
 # state unusable under the new compile geometry.
 _SHAPE_FIELDS = ("num_nodes", "active_set_size", "rc_slots", "hist_bins")
+
+# EngineParams fields describing the impairment schedule (v3 meta block);
+# the all-off backfill for pre-v3 files derives from the engine's own
+# defaults so the two can never drift apart.
+_IMPAIR_FIELDS = ("packet_loss_rate", "churn_fail_rate",
+                  "churn_recover_rate", "partition_at", "heal_at",
+                  "impair_seed")
+_IMPAIR_DEFAULTS = {f: EngineParams._field_defaults[f]
+                    for f in _IMPAIR_FIELDS}
 
 
 def save_state(path: str, state, params, config=None,
@@ -38,9 +58,12 @@ def save_state(path: str, state, params, config=None,
     in the absolute iteration number, so resumption is bit-exact)."""
     arrays = {f"state.{name}": np.asarray(getattr(state, name))
               for name in state._fields}
+    pdict = dict(params._asdict())
     meta = {
         "format_version": _FORMAT_VERSION,
-        "params": dict(params._asdict()),
+        "params": pdict,
+        "impair": {f: pdict.get(f, _IMPAIR_DEFAULTS[f])
+                   for f in _IMPAIR_FIELDS},
         "iteration": int(iteration),
     }
     if config is not None:
@@ -48,8 +71,23 @@ def save_state(path: str, state, params, config=None,
         cfg["test_type"] = str(cfg["test_type"])
         cfg["step_size"] = str(cfg["step_size"])
         meta["config"] = cfg
-    np.savez_compressed(path, __meta__=np.frombuffer(
-        json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    # Atomic write: savez to a temp file in the target directory, then
+    # os.replace — a killed run can never leave a truncated --resume source.
+    if not path.endswith(".npz"):
+        path += ".npz"   # np.savez would append it; make the target explicit
+    fd, tmp = tempfile.mkstemp(
+        suffix=".npz", prefix=".ckpt-", dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, __meta__=np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     log.info("checkpoint saved: %s (%s arrays)", path, len(arrays))
 
 
@@ -67,11 +105,21 @@ def load_state(path: str, params=None):
         arrays = {k[len("state."):]: z[k] for k in z.files
                   if k.startswith("state.")}
     stored = meta["params"]
+    # pre-v3 backfill: impairment knobs default to all-off
+    meta.setdefault("impair", dict(_IMPAIR_DEFAULTS))
     if params is not None:
         for f in _SHAPE_FIELDS:
             if getattr(params, f) != stored[f]:
                 raise ValueError(
                     f"checkpoint {f}={stored[f]} != current {getattr(params, f)}")
+        for f in _IMPAIR_FIELDS:
+            if getattr(params, f, _IMPAIR_DEFAULTS[f]) != meta["impair"][f]:
+                log.warning(
+                    "WARNING: resuming with %s=%s but checkpoint was written "
+                    "with %s — the continuation's impairment schedule "
+                    "diverges from the original run",
+                    f, getattr(params, f, _IMPAIR_DEFAULTS[f]),
+                    meta["impair"][f])
     return arrays, stored, meta
 
 

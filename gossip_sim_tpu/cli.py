@@ -19,8 +19,8 @@ import numpy as np
 from .config import Config, StepSize, Testing
 from .constants import (AGGREGATE_HOPS_FAIL_NODES_HISTOGRAM_UPPER_BOUND,
                         AGGREGATE_HOPS_MIN_INGRESS_NODES_HISTOGRAM_UPPER_BOUND,
-                        API_MAINNET_BETA, STANDARD_HISTOGRAM_UPPER_BOUND,
-                        UNREACHED,
+                        API_MAINNET_BETA, COVERAGE_RECOVERY_THRESHOLD,
+                        STANDARD_HISTOGRAM_UPPER_BOUND, UNREACHED,
                         VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS,
                         get_influx_url, get_json_rpc_url)
 from .identity import NodeIndex
@@ -34,7 +34,10 @@ from .stats.gossip_stats import GossipStats, GossipStatsCollection
 
 log = logging.getLogger("gossip_sim_tpu")
 
-POOR_COVERAGE_THRESHOLD = 0.95  # gossip_main.rs:408
+# gossip_main.rs:408; by design the recovery metric (faults.py) uses the
+# same bar — a run warned as "poor coverage" is exactly one not yet
+# recovered, so the two must never drift apart
+POOR_COVERAGE_THRESHOLD = COVERAGE_RECOVERY_THRESHOLD
 
 
 def _warn_shape_truncation(rows, params) -> tuple[int, int]:
@@ -47,6 +50,13 @@ def _warn_shape_truncation(rows, params) -> tuple[int, int]:
     it instead of letting sweeps drift."""
     dropped = int(np.asarray(rows["inb_dropped"]).sum())
     overflow = int(np.asarray(rows["rc_overflow"]).sum())
+    clamped = int(np.asarray(rows.get("hop_clamped", 0)).sum())
+    if clamped:
+        log.warning(
+            "WARNING: %s hop sample(s) reached the top on-device histogram "
+            "bin (hist_bins=%s) and were clamped — aggregate hop mean/"
+            "median/max under-report the true tail. Raise "
+            "EngineParams.hist_bins.", clamped, params.hist_bins)
     if dropped:
         log.warning(
             "WARNING: %s inbound message(s) exceeded the engine's ranking "
@@ -59,6 +69,16 @@ def _warn_shape_truncation(rows, params) -> tuple[int, int]:
             "were evicted early — prune decisions may diverge. Raise "
             "EngineParams.rc_slots.", overflow, params.rc_slots)
     return dropped, overflow
+
+
+def _impair_params(config) -> dict:
+    """EngineParams kwargs for the fault-injection knobs (engine/params.py)."""
+    return dict(packet_loss_rate=config.packet_loss_rate,
+                churn_fail_rate=config.churn_fail_rate,
+                churn_recover_rate=config.churn_recover_rate,
+                partition_at=config.partition_at,
+                heal_at=config.heal_at,
+                impair_seed=config.seed)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -112,6 +132,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="On what iteration should the nodes fail")
     p.add_argument("--warm-up-rounds", type=int, default=200,
                    help="Number of gossip rounds to run before measuring statistics")
+    # ---- fault injection / network impairments (faults.py) -------------
+    p.add_argument("--packet-loss-rate", type=float, default=0.0,
+                   help="drop each gossip message with this probability "
+                        "(stateless counter hash; bit-equivalent across "
+                        "backends)")
+    p.add_argument("--churn-fail-rate", type=float, default=0.0,
+                   help="per-iteration probability that an alive node fails")
+    p.add_argument("--churn-recover-rate", type=float, default=0.0,
+                   help="per-iteration probability that a failed node "
+                        "recovers and rejoins delivery")
+    p.add_argument("--partition-at", type=int, default=-1,
+                   help="iteration at which a stake-balanced bipartition "
+                        "starts suppressing cross-partition messages "
+                        "(-1 = never)")
+    p.add_argument("--heal-at", type=int, default=-1,
+                   help="iteration at which the partition heals (-1 = never)")
     p.add_argument("--influx", default="n",
                    help="Influx for reporting metrics. i for internal-metrics, "
                         "l for localhost, n for none")
@@ -156,6 +192,14 @@ def config_from_args(args) -> Config:
         raise SystemExit("rotation-probability must be between 0 and 1")
     if not 0.0 <= args.prune_stake_threshold <= 1.0:
         raise SystemExit("prune-stake-threshold must be between 0 and 1")
+    for flag in ("packet_loss_rate", "churn_fail_rate", "churn_recover_rate"):
+        if not 0.0 <= getattr(args, flag) <= 1.0:
+            raise SystemExit(
+                f"{flag.replace('_', '-')} must be between 0 and 1")
+    if args.heal_at >= 0 and args.partition_at < 0:
+        raise SystemExit("heal-at requires partition-at")
+    if args.partition_at >= 0 and 0 <= args.heal_at < args.partition_at:
+        raise SystemExit("heal-at must not precede partition-at")
     return Config(
         gossip_push_fanout=args.push_fanout,
         gossip_active_set_size=args.active_set_size,
@@ -172,6 +216,11 @@ def config_from_args(args) -> Config:
         num_buckets_for_hops_stats_hist=args.num_buckets_hops,
         fraction_to_fail=args.fraction_to_fail,
         when_to_fail=args.when_to_fail,
+        packet_loss_rate=args.packet_loss_rate,
+        churn_fail_rate=args.churn_fail_rate,
+        churn_recover_rate=args.churn_recover_rate,
+        partition_at=args.partition_at,
+        heal_at=args.heal_at,
         test_type=Testing.parse(args.test_type),
         num_simulations=args.num_simulations,
         step_size=StepSize.parse(args.step_size),
@@ -260,6 +309,18 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
         node.initialize_gossip(rng, stakes, config.gossip_active_set_size)
     log.info("Simulation Complete!")
 
+    impair = None
+    if config.wants_delivery_stats:
+        # also built with all-zero knobs for an impairment sweep's baseline
+        # point, where it classifies every push as delivered
+        from .faults import FaultInjector
+        impair = FaultInjector(
+            NodeIndex.from_stakes(accounts), seed=config.seed,
+            packet_loss_rate=config.packet_loss_rate,
+            churn_fail_rate=config.churn_fail_rate,
+            churn_recover_rate=config.churn_recover_rate,
+            partition_at=config.partition_at, heal_at=config.heal_at)
+
     cluster = Cluster(config.gossip_push_fanout)
     for it in range(config.gossip_iterations):
         if it % 10 == 0:
@@ -268,7 +329,11 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
         if config.test_type == Testing.FAIL_NODES and it == config.when_to_fail:
             cluster.fail_nodes(config.fraction_to_fail, nodes, rng)
             stats.set_failed_nodes(cluster.failed_nodes)
-        cluster.run_gossip(origin_pubkey, stakes, node_map)
+        if impair is not None:
+            impair.begin_round(it)
+            if impair.has_churn:
+                cluster.apply_churn(impair, it, node_map)
+        cluster.run_gossip(origin_pubkey, stakes, node_map, impair)
         cluster.consume_messages(origin_pubkey, nodes)
         cluster.send_prunes(origin_pubkey, nodes, config.prune_stake_threshold,
                             config.min_ingress_nodes, stakes)
@@ -285,9 +350,14 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
                                  stakes, config.probability_of_rotation)
         if it + 1 == config.warm_up_rounds:
             cluster.clear_message_counts()
+        post_heal = config.heal_at >= 0 and it >= config.heal_at
+        if post_heal or it >= config.warm_up_rounds:
+            coverage, n_stranded = cluster.coverage(stakes)
+        if post_heal:
+            # recovery metric sees every post-heal round, warm-up included
+            stats.note_post_heal_coverage(it, coverage)
         if it >= config.warm_up_rounds:
             steady = it - config.warm_up_rounds
-            coverage, n_stranded = cluster.coverage(stakes)
             if coverage < POOR_COVERAGE_THRESHOLD:
                 log.warning("WARNING: poor coverage for origin: %s, %s",
                             origin_pubkey, coverage)
@@ -300,8 +370,14 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
             stats.update_prune_counts(cluster.prune_messages_sent)
             rmr_result = cluster.relative_message_redundancy()
             stats.insert_rmr(rmr_result[0])
+            if impair is not None:
+                stats.insert_delivery(impair.delivered, impair.dropped,
+                                      impair.suppressed,
+                                      len(cluster.failed_nodes))
             _push_iteration_points(config, dp_queue, sim_iter, start_ts,
                                    stats, steady, coverage, rmr_result)
+    if impair is not None and impair.has_churn:
+        stats.set_failed_nodes(cluster.failed_nodes)
     return stakes
 
 
@@ -330,6 +406,7 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
                  if config.test_type == Testing.FAIL_NODES else -1),
         fail_fraction=(config.fraction_to_fail
                        if config.test_type == Testing.FAIL_NODES else 0.0),
+        **_impair_params(config),
     )
     tables = make_cluster_tables(index.stakes.astype(np.int64))
     origin_idx = index.index_of(origin_pubkey)
@@ -347,7 +424,9 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
                   "gossip_push_fanout", "gossip_active_set_size",
                   "probability_of_rotation", "prune_stake_threshold",
                   "min_ingress_nodes", "warm_up_rounds",
-                  "fraction_to_fail", "when_to_fail"):
+                  "fraction_to_fail", "when_to_fail",
+                  "packet_loss_rate", "churn_fail_rate",
+                  "churn_recover_rate", "partition_at", "heal_at"):
             if f in saved_cfg and saved_cfg[f] != getattr(config, f):
                 log.warning("WARNING: resuming with %s=%s but checkpoint "
                             "was written with %s=%s — continuation is NOT "
@@ -389,8 +468,16 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
         for it in range(start_iter, warm, 10):
             log.info("GOSSIP ITERATION: %s", it)
             _push_config_point(config, dp_queue, sim_iter, start_ts)
-        state, _ = run_rounds(params, tables, origins, state,
-                              warm - start_iter, start_it=start_iter)
+        state, wrows = run_rounds(params, tables, origins, state,
+                                  warm - start_iter, start_it=start_iter)
+        if config.heal_at >= 0 and config.heal_at < warm:
+            # post-heal coverage inside the warm-up scan still feeds the
+            # recovery metric (iteration-exact, like the oracle loop and
+            # the all-origins aggregate path)
+            for t, cov in enumerate(
+                    np.asarray(wrows["coverage"])[:, 0].tolist()):
+                if start_iter + t >= config.heal_at:
+                    stats.note_post_heal_coverage(start_iter + t, cov)
         if start_iter <= params.fail_at < warm:
             _record_failed()
         _save_checkpoint(warm)
@@ -430,6 +517,9 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
         log.info("jax.profiler trace written to %s", config.jax_profile_dir)
 
     _feed_message_counters(stats, state, 0, index)
+    if params.has_churn:
+        # mirror the oracle backend: report the final churn-failed set
+        _record_failed()
     _save_checkpoint(config.gossip_iterations)
     return stakes
 
@@ -441,6 +531,8 @@ def _feed_measured_round(stats, rows, t, col, it, config, index, stakes,
     (gossip_main.rs:480-563)."""
     steady = it - config.warm_up_rounds
     coverage = float(rows["coverage"][t, col])
+    if config.heal_at >= 0 and it >= config.heal_at:
+        stats.note_post_heal_coverage(it, coverage)
     if coverage < POOR_COVERAGE_THRESHOLD:
         log.warning("WARNING: poor coverage for origin: %s, %s",
                     origin_pubkey, coverage)
@@ -455,6 +547,11 @@ def _feed_measured_round(stats, rows, t, col, it, config, index, stakes,
     rmr_result = (float(rows["rmr"][t, col]), int(rows["m"][t, col]),
                   int(rows["n"][t, col]))
     stats.insert_rmr(rmr_result[0])
+    if config.wants_delivery_stats:
+        stats.insert_delivery(int(rows["delivered"][t, col]),
+                              int(rows["dropped"][t, col]),
+                              int(rows["suppressed"][t, col]),
+                              int(rows["failed_count"][t, col]))
     _push_iteration_points(config, dp_queue, sim_iter, start_ts,
                            stats, steady, coverage, rmr_result)
 
@@ -522,6 +619,7 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         prune_stake_threshold=config.prune_stake_threshold,
         min_ingress_nodes=config.min_ingress_nodes,
         warm_up_rounds=config.warm_up_rounds,
+        **_impair_params(config),
     )
     tables = make_cluster_tables(index.stakes.astype(np.int64))
 
@@ -558,7 +656,15 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
     if warm > 0:
         for it in range(0, warm, 10):
             log.info("GOSSIP ITERATION: %s", it)
-        state, _ = run_rounds(params, tables, origins, state, warm)
+        state, wrows = run_rounds(params, tables, origins, state, warm)
+        if config.heal_at >= 0 and config.heal_at < warm:
+            # heal inside warm-up: the recovery metric still needs every
+            # post-heal round (iteration-exact, like the other run paths)
+            cov_w = np.asarray(wrows["coverage"])            # [warm, R]
+            for it in range(config.heal_at, warm):
+                for col in range(R):
+                    stats_list[col].note_post_heal_coverage(
+                        it, float(cov_w[it, col]))
     measured = config.gossip_iterations - warm
     block = 256
     done = 0
@@ -620,6 +726,7 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         prune_stake_threshold=config.prune_stake_threshold,
         min_ingress_nodes=config.min_ingress_nodes,
         warm_up_rounds=config.warm_up_rounds,
+        **_impair_params(config),
     )
     tables = make_cluster_tables(index.stakes.astype(np.int64))
 
@@ -668,7 +775,9 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         state_np = jax.tree_util.tree_map(np.asarray, state)
         state_np = type(state_np)(**{
             f: getattr(state_np, f)[:n_valid] for f in state_np._fields})
-        agg.add_batch(rows, state_np, config.warm_up_rounds)
+        agg.add_batch(rows, state_np, config.warm_up_rounds,
+                      heal_at=config.heal_at,
+                      impaired=config.impairments_on)
         log.info("all-origins: %s/%s origins done",
                  min(lo + n_valid, total_o), total_o)
     dt = time.time() - t0
@@ -686,7 +795,8 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         }
     agg.finalize(config)
     _warn_shape_truncation(
-        {"inb_dropped": agg.inb_dropped, "rc_overflow": agg.rc_overflow},
+        {"inb_dropped": agg.inb_dropped, "rc_overflow": agg.rc_overflow,
+         "hop_clamped": agg.hop_clamped},
         params)
     if config.print_stats:
         agg.print_all()
@@ -737,6 +847,12 @@ def _push_iteration_points(config, dp_queue, sim_iter, start_ts, stats,
     dp.create_data_point(
         stats.get_outbound_branching_factor_by_index(steady),
         "branching_factor")
+    if stats.has_delivery_stats():
+        dp.create_delivery_point(
+            int(stats.delivered_stats.collection[-1]),
+            int(stats.dropped_stats.collection[-1]),
+            int(stats.suppressed_stats.collection[-1]),
+            stats.failed_count_series[-1])
     dp.create_iteration_point(steady, sim_iter)
     dp_queue.push_back(dp)
 
@@ -764,6 +880,12 @@ def _push_end_of_sim_points(config, dp_queue, sim_iter, start_ts, stats):
                              stats.get_ingress_messages_histogram(), sim_iter)
     dp.create_messages_point("prune_message_count",
                              stats.get_prune_message_histogram(), sim_iter)
+    if stats.recovery_iterations is not None:
+        # single-origin run: one recovery sample (mean == max; 0 with
+        # unrecovered=1 when coverage never came back)
+        rec = stats.recovery_iterations
+        dp.create_recovery_point(1, float(max(rec, 0)), max(rec, 0),
+                                 int(rec < 0))
     dp.create_iteration_point(0, sim_iter)
     dp_queue.push_back(dp)
 
@@ -903,6 +1025,18 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
                  + i * config.step_size.as_float())
             c = config.stepped(probability_of_rotation=v)
             start = float(config.probability_of_rotation)
+        elif tt == Testing.PACKET_LOSS:
+            v = min(config.packet_loss_rate
+                    + i * config.step_size.as_float(), 1.0)
+            c = config.stepped(packet_loss_rate=v)
+            start = float(config.packet_loss_rate)
+        elif tt == Testing.CHURN:
+            # sweep the fail rate; the recover rate rides along unstepped so
+            # each point probes a different steady-state failed fraction
+            v = min(config.churn_fail_rate
+                    + i * config.step_size.as_float(), 1.0)
+            c = config.stepped(churn_fail_rate=v)
+            start = float(config.churn_fail_rate)
         else:  # NO_TEST
             c, start = config, 0.0
         run_simulation(c, json_rpc_url, collection, dp_queue, i, start_ts,

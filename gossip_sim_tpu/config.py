@@ -19,6 +19,8 @@ class Testing(enum.Enum):
     ORIGIN_RANK = "origin-rank"
     FAIL_NODES = "fail-nodes"
     ROTATE_PROBABILITY = "rotate-probability"
+    PACKET_LOSS = "packet-loss"
+    CHURN = "churn"
     NO_TEST = "no-test"
 
     def __str__(self):
@@ -31,6 +33,8 @@ class Testing(enum.Enum):
             Testing.ORIGIN_RANK: "OriginRank",
             Testing.FAIL_NODES: "FailNodes",
             Testing.ROTATE_PROBABILITY: "RotateProbability",
+            Testing.PACKET_LOSS: "PacketLoss",
+            Testing.CHURN: "Churn",
             Testing.NO_TEST: "NoTest",
         }[self]
 
@@ -92,6 +96,15 @@ class Config:
     warm_up_rounds: int = 200
     print_stats: bool = False
 
+    # Network-impairment / fault-injection knobs (faults.py; both backends,
+    # bit-equivalent decisions under a shared seed).  All-off defaults keep
+    # every output bit-identical to the unimpaired simulator:
+    packet_loss_rate: float = 0.0   # per-message Bernoulli drop probability
+    churn_fail_rate: float = 0.0    # per-iteration P(alive node fails)
+    churn_recover_rate: float = 0.0  # per-iteration P(failed node recovers)
+    partition_at: int = -1          # iteration the stake bipartition starts
+    heal_at: int = -1               # iteration it heals (-1 = never)
+
     # TPU-framework extensions (not in the reference):
     backend: str = "tpu"            # "tpu" | "oracle"
     seed: int = 42                  # deterministic by construction
@@ -106,3 +119,19 @@ class Config:
 
     def stepped(self, **kw) -> "Config":
         return replace(self, **kw)
+
+    @property
+    def impairments_on(self) -> bool:
+        """Any fault-injection knob beyond the reference's one-shot
+        FAIL_NODES (mirrors EngineParams.has_impairments)."""
+        return (self.packet_loss_rate > 0.0 or self.churn_fail_rate > 0.0
+                or self.churn_recover_rate > 0.0 or self.partition_at >= 0)
+
+    @property
+    def wants_delivery_stats(self) -> bool:
+        """Record delivered/dropped/suppressed counters: when impairments
+        are on, OR when the run is a point of an impairment sweep — so the
+        sweep's rate-0 baseline still emits its delivery series and the
+        degradation trend has an anchor."""
+        return (self.impairments_on
+                or self.test_type in (Testing.PACKET_LOSS, Testing.CHURN))

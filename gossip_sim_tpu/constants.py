@@ -32,6 +32,11 @@ API_TESTNET = "https://api.testnet.solana.com"
 INFLUX_INTERNAL_METRICS = "https://internal-metrics.solana.com:8086"
 INFLUX_LOCALHOST = "http://localhost:8086"
 
+# Coverage level a healed/recovering cluster must regain for the
+# iterations-to-recover metric (faults.py workloads); matches the CLI's
+# poor-coverage warning threshold (gossip_main.rs:408).
+COVERAGE_RECOVERY_THRESHOLD = 0.95
+
 # Histogram bounds (reference: lib.rs:14-17).
 VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS = 50
 AGGREGATE_HOPS_FAIL_NODES_HISTOGRAM_UPPER_BOUND = 40.0

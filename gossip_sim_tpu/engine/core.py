@@ -38,6 +38,17 @@ Node failure (gossip.rs:756-771) is tracked per active-set slot
 (``tfail``) and maintained incrementally at rotation/failure events so the
 hot path never gathers ``failed[peer]``.
 
+Network impairments (faults.py) extend the one-shot failure with per-message
+packet loss, continuous fail/recover churn, and a transient stake
+bipartition.  Every impairment decision is a stateless counter hash of
+``(impair_seed, iteration, node ids)`` computed bit-identically by the CPU
+oracle, so parity stays testable under faults.  The blocks are gated on the
+static ``EngineParams`` knobs: with all knobs at their defaults the compiled
+round is the exact unimpaired graph (reference parity preserved).  Churn
+rebuilds the ``tfail`` slot bits once per round via the same sort-join used
+by the one-shot event; the partition side lookup is the one gather on the
+impaired path (it only exists when ``partition_at >= 0``).
+
 Documented divergences from the reference are unchanged from v1 (see
 git history of this module): distributional sampling parity, exact prune
 bits instead of 0.1-fp blooms, ``inbound_cap`` ranking, ``rc_slots``
@@ -54,6 +65,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..faults import (SALT_CHURN, SALT_EDGE, edge_u32_arr, node_u32_arr,
+                      rate_threshold, round_basis_arr, stake_bipartition)
 from ..identity import stake_buckets_array
 from .params import EngineParams
 from .sampler import SamplerTables, build_sampler_tables
@@ -85,6 +98,8 @@ class ClusterTables(NamedTuple):
     sampler: SamplerTables
     shi: jax.Array       # [N + 1] i32 stake >> 31 (sort-key split)
     slo: jax.Array       # [N + 1] i32 stake & 0x7fffffff
+    side: jax.Array      # [N + 1] i32 stake-bipartition side (faults.py);
+                         # index N is a 0 pad — only read under partition_at
 
 
 class SimState(NamedTuple):
@@ -118,12 +133,14 @@ def make_cluster_tables(stakes_lamports: np.ndarray) -> ClusterTables:
         raise ValueError("stakes must be in [0, 2^62)")
     buckets = stake_buckets_array(stakes.astype(np.uint64)).astype(np.int32)
     padded = np.concatenate([stakes, [0]])
+    side = np.concatenate([stake_bipartition(stakes).astype(np.int32), [0]])
     return ClusterTables(
         stakes=jnp.asarray(padded),
         buckets=jnp.asarray(buckets),
         sampler=build_sampler_tables(buckets),
         shi=jnp.asarray((padded >> 31).astype(np.int32)),
         slo=jnp.asarray((padded & 0x7FFFFFFF).astype(np.int32)),
+        side=jnp.asarray(side),
     )
 
 
@@ -345,6 +362,20 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         failed, tfail = lax.cond(it == p.fail_at, _fail,
                                  lambda ft: ft, (failed, tfail))
 
+    # ---- continuous churn (faults.py): one hash per (iteration, node),
+    # interpreted against the node's current state; recovered nodes rejoin
+    # delivery immediately (their tfail bits clear this round) -------------
+    if p.has_churn:
+        basis_c = round_basis_arr(p.impair_seed, it, SALT_CHURN, jnp)
+        hu64 = node_u32_arr(basis_c, jnp.arange(N, dtype=jnp.uint32),
+                            jnp).astype(jnp.uint64)
+        fail_ev = hu64 < rate_threshold(p.churn_fail_rate)       # [N]
+        rec_ev = hu64 < rate_threshold(p.churn_recover_rate)     # [N]
+        failed = jnp.where(failed, ~rec_ev[None, :], fail_ev[None, :])
+        q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
+        tfail = (_lookup(failed.astype(jnp.int32), q, N,
+                         pack).reshape(O, N, S) == 1) & (state.active < N)
+
     # ---- verb 1: push targets (gossip.rs:494-615) -----------------------
     peer = state.active
     is_peer = peer < N
@@ -358,7 +389,27 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         (skey, peer, tfail.astype(jnp.int32)), dimension=-1, num_keys=1)
     slot_ok = skey_s[..., :F] < S
     peerF = peer_sf[..., :F]
-    tgt = jnp.where(slot_ok & (tfail_sf[..., :F] == 0), peerF, N)  # [O,N,F]
+    # live candidate pushes; partition suppression and packet loss consume
+    # the slot exactly like failed targets do (precedence: failed target >
+    # partition > loss — matching the oracle's classify_edge)
+    deliver_ok = slot_ok & (tfail_sf[..., :F] == 0)              # [O,N,F]
+    sup_mask = drop_mask = None
+    if p.partition_at >= 0:
+        part_on = it >= p.partition_at
+        if p.heal_at >= 0:
+            part_on = part_on & (it < p.heal_at)
+        side_dst = tables.side[jnp.minimum(peerF, N)]            # [O,N,F]
+        sup_mask = (deliver_ok & part_on
+                    & (tables.side[:N][None, :, None] != side_dst))
+        deliver_ok = deliver_ok & ~sup_mask
+    if p.packet_loss_rate > 0.0:
+        basis_e = round_basis_arr(p.impair_seed, it, SALT_EDGE, jnp)
+        ue = edge_u32_arr(basis_e, iota_n.astype(jnp.uint32)[:, :, None],
+                          peerF.astype(jnp.uint32), jnp)
+        drop_mask = deliver_ok & (ue.astype(jnp.uint64)
+                                  < rate_threshold(p.packet_loss_rate))
+        deliver_ok = deliver_ok & ~drop_mask
+    tgt = jnp.where(deliver_ok, peerF, N)                        # [O,N,F]
     tgtf = tgt.reshape(O, NF)
     pseudo_t = jnp.broadcast_to(iota_n, (O, N))
 
@@ -400,6 +451,15 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     deg_out = jnp.sum(delivered, axis=-1, dtype=jnp.int32)       # egress
     m_push = jnp.sum(deg_out, axis=-1, dtype=jnp.int32)          # [O]
     n_reached = jnp.sum(reached, axis=-1, dtype=jnp.int32)       # [O]
+    # degraded-delivery counters: only sends from reached sources exist
+    # (the oracle's BFS likewise only attempts pushes from visited nodes)
+    zero_o = jnp.zeros((O,), jnp.int32)
+    dropped_cnt = (jnp.sum(drop_mask & reached[:, :, None], axis=(1, 2),
+                           dtype=jnp.int32) if drop_mask is not None
+                   else zero_o)
+    suppressed_cnt = (jnp.sum(sup_mask & reached[:, :, None], axis=(1, 2),
+                              dtype=jnp.int32) if sup_mask is not None
+                      else zero_o)
 
     hop1 = jnp.minimum(dist + 1, H - 1)                          # [O,N] per src
     # per-edge payloads, src-major (free broadcasts)
@@ -708,10 +768,23 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         "inb_dropped": inb_dropped,
         "rc_overflow": rc_overflow,
         "rot_failed": rot_failed,
+        # degraded-delivery accounting (faults.py; all-zero when the
+        # impairment knobs are off)
+        "delivered": m_push,
+        "dropped": dropped_cnt,
+        "suppressed": suppressed_cnt,
+        "failed_count": jnp.sum(failed, axis=-1, dtype=jnp.int32),
+        # hop-histogram clamp guard: nodes whose true hop distance exceeds
+        # the last bin (dist > H - 1) and was clamped into it by the
+        # min(dist, H - 1) binning above; dist == H - 1 is that bin's
+        # legitimate value and does not count
+        "hop_clamped": jnp.sum(reached & (dist >= H), axis=-1,
+                               dtype=jnp.int32),
     }
     if detail:
         rows["stranded_mask"] = stranded
         rows["dist"] = jnp.where(reached, dist, -1).astype(jnp.int32)
+        rows["failed_mask"] = failed
     if edge_detail:
         # per-edge hop matrix: the engine equivalent of the reference's
         # ``orders`` debug dump (gossip.rs:374-390) — edge (src -> tgt)

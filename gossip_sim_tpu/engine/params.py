@@ -29,6 +29,19 @@ class EngineParams(NamedTuple):
     min_num_upserts: int = MIN_NUM_UPSERTS          # received_cache.rs:21
     received_cap: int = RECEIVED_CACHE_CAPACITY     # received_cache.rs:78
 
+    # Network-impairment / fault-injection knobs (faults.py; no reference
+    # equivalent beyond the one-shot fail_at above).  All decisions are
+    # stateless counter hashes of (impair_seed, iteration, node ids), shared
+    # bit-exactly with the oracle's FaultInjector.  With every knob at its
+    # default the compiled round is IDENTICAL to the unimpaired engine
+    # (the blocks are gated on these static fields).
+    packet_loss_rate: float = 0.0    # per-message Bernoulli drop probability
+    churn_fail_rate: float = 0.0     # per-iteration P(alive node fails)
+    churn_recover_rate: float = 0.0  # per-iteration P(failed node recovers)
+    partition_at: int = -1           # iteration the stake bipartition starts
+    heal_at: int = -1                # iteration it heals (-1 = never)
+    impair_seed: int = 0             # hash seed for all impairment streams
+
     # Dense-shape knobs (TPU formulation only; see engine/core.py for the
     # documented divergences they introduce):
     rc_slots: int = 64      # physical received-cache slots per (origin, node)
@@ -45,6 +58,17 @@ class EngineParams(NamedTuple):
     @property
     def num_buckets(self) -> int:
         return NUM_PUSH_ACTIVE_SET_ENTRIES
+
+    @property
+    def has_impairments(self) -> bool:
+        """True when any fault-injection knob beyond the reference's one-shot
+        ``fail_at`` is active (selects the impairment-aware compiled round)."""
+        return (self.packet_loss_rate > 0.0 or self.has_churn
+                or self.partition_at >= 0)
+
+    @property
+    def has_churn(self) -> bool:
+        return self.churn_fail_rate > 0.0 or self.churn_recover_rate > 0.0
 
     @property
     def k_inbound(self) -> int:
@@ -66,4 +90,10 @@ class EngineParams(NamedTuple):
             "rc_slots too small for the received-cache insert cap")
         assert self.k_inbound >= 2, "need at least the two scored ranks"
         assert self.init_draws > self.active_set_size
+        for r in (self.packet_loss_rate, self.churn_fail_rate,
+                  self.churn_recover_rate):
+            assert 0.0 <= r <= 1.0, "impairment rates must be in [0, 1]"
+        if self.partition_at >= 0 and self.heal_at >= 0:
+            assert self.heal_at >= self.partition_at, (
+                "heal_at must not precede partition_at")
         return self

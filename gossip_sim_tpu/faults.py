@@ -1,0 +1,222 @@
+"""Network-impairment and fault-injection primitives, shared by both backends.
+
+The reference simulator models exactly one fault: a one-shot random node
+failure (gossip.rs:756-771).  This module adds the degraded-network regimes
+real gossip runs in — per-message packet loss, continuous fail/recover churn,
+and transient stake bipartitions — with one hard requirement: the TPU engine
+(engine/core.py) and the CPU oracle (oracle/cluster.py) must make
+*bit-identical* impairment decisions under a shared seed, so oracle-vs-engine
+parity remains testable under faults (tests/test_faults.py).
+
+That rules out shared stateful RNG streams (the two backends consume
+randomness in different orders).  Instead every decision is a *stateless
+counter hash*:
+
+    drop(edge)   = fmix32(base_e(seed, it) ^ src*C1 ^ dst*C2)  < p_loss  * 2^32
+    fail(node)   = fmix32(base_c(seed, it) ^ node*C1)          < p_fail  * 2^32
+    recover(node)= same hash                                   < p_recov * 2^32
+
+``fmix32`` is the murmur3-style 32-bit finalizer; all arithmetic is mod 2^32,
+expressible identically in pure-Python ints (oracle) and uint32 lanes
+(engine, VPU-elementwise — effectively free at these shapes).  The churn hash
+is evaluated once per (iteration, node) and interpreted against the node's
+current state, so fail and recover never race.
+
+The partition fault is deterministic given the cluster: a greedy
+stake-balanced bipartition (largest stake first onto the lighter side),
+active while ``partition_at <= it < heal_at``.  Cross-partition edges are
+suppressed (the slot is consumed, nothing is delivered — the same semantics
+as pushes to failed nodes, gossip.rs:538-541).
+
+Precedence per push slot: failed target > partition suppression > packet
+loss > delivery.  Dropped and suppressed messages consume the fanout slot
+and are counted, but contribute nothing to delivery, ingress, consume
+ranking, or RMR's m.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M32 = 0xFFFFFFFF
+_GOLD = 0x9E3779B1          # 2^32 / phi, round-mixing multiplier
+_C1 = 0x85EBCA6B            # murmur3 fmix constants reused as lane salts
+_C2 = 0xC2B2AE35
+SALT_EDGE = 0x7F4A7C15      # domain separation: packet-loss stream
+SALT_CHURN = 0x2545F491     # domain separation: churn stream
+
+
+def fmix32(x: int) -> int:
+    """Murmur3 32-bit finalizer on Python ints (the oracle's scalar path)."""
+    x &= _M32
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & _M32
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & _M32
+    x ^= x >> 16
+    return x
+
+
+def fmix32_arr(x, xp=np):
+    """``fmix32`` on uint32 arrays (numpy or jax.numpy) — multiplication
+    wraps mod 2^32 in both, so results match the scalar path bit-for-bit."""
+    x = x ^ (x >> 16)
+    x = x * xp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * xp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def round_basis(seed: int, it: int, salt: int) -> int:
+    """Per-(seed, iteration, domain) hash basis; scalar path."""
+    return fmix32((seed & _M32) ^ fmix32((it * _GOLD + salt) & _M32))
+
+
+def round_basis_arr(seed: int, it, salt: int, xp=np):
+    """``round_basis`` with a (possibly traced) uint32 iteration scalar."""
+    itu = it.astype(xp.uint32) if hasattr(it, "astype") else xp.uint32(it & _M32)
+    h = fmix32_arr(itu * xp.uint32(_GOLD) + xp.uint32(salt), xp)
+    return fmix32_arr(xp.uint32(seed & _M32) ^ h, xp)
+
+
+def edge_u32(basis: int, src: int, dst: int) -> int:
+    """Per-edge hash in [0, 2^32); scalar path (oracle)."""
+    return fmix32(basis ^ ((src * _C1) & _M32) ^ ((dst * _C2) & _M32))
+
+
+def edge_u32_arr(basis, src, dst, xp=np):
+    """Vectorized ``edge_u32``: basis scalar/array, src/dst uint32 arrays."""
+    return fmix32_arr(basis ^ (src * xp.uint32(_C1)) ^ (dst * xp.uint32(_C2)),
+                      xp)
+
+
+def node_u32(basis: int, node: int) -> int:
+    """Per-node churn hash in [0, 2^32); scalar path (oracle)."""
+    return fmix32(basis ^ ((node * _C1) & _M32))
+
+
+def node_u32_arr(basis, node, xp=np):
+    return fmix32_arr(basis ^ (node * xp.uint32(_C1)), xp)
+
+
+def rate_threshold(rate: float) -> int:
+    """Bernoulli(rate) as an integer threshold: event iff u32 < threshold.
+
+    Exact at the endpoints: rate <= 0 never fires, rate >= 1 always fires
+    (threshold 2^32 exceeds every u32, so compare in 64-bit)."""
+    if rate <= 0.0:
+        return 0
+    if rate >= 1.0:
+        return 1 << 32
+    return int(rate * (1 << 32))
+
+
+def partition_active(it: int, partition_at: int, heal_at: int) -> bool:
+    """Partition window: [partition_at, heal_at); heal_at < 0 = never heals."""
+    if partition_at < 0:
+        return False
+    return it >= partition_at and (heal_at < 0 or it < heal_at)
+
+
+def stake_bipartition(stakes) -> np.ndarray:
+    """Deterministic stake-balanced bipartition -> bool side per node.
+
+    Greedy: walk nodes by (stake desc, index asc), assign each to the
+    currently lighter side.  Both backends derive the identical split from
+    the index-ordered stake vector alone, so no side table needs to be
+    communicated."""
+    stakes = np.asarray(stakes, dtype=np.int64)
+    n = stakes.shape[0]
+    # plain-int loop (no per-element numpy scalars): make_cluster_tables
+    # builds the split unconditionally, so it must stay cheap at the 32k
+    # node cap even on unimpaired runs
+    order = np.lexsort((np.arange(n), -stakes)).tolist()
+    vals = stakes.tolist()
+    side = [False] * n
+    tot0 = tot1 = 0
+    for i in order:
+        if tot1 < tot0:
+            side[i] = True
+            tot1 += vals[i]
+        else:
+            tot0 += vals[i]
+    return np.asarray(side, dtype=bool)
+
+
+class FaultInjector:
+    """Oracle-side impairment driver (the engine inlines the same hashes in
+    engine/core.py round_step).
+
+    Works on a ``NodeIndex`` so the hash inputs are the same dense node ids
+    the engine uses; pubkeys are translated at the call boundary.
+    """
+
+    def __init__(self, index, seed: int = 0, packet_loss_rate: float = 0.0,
+                 churn_fail_rate: float = 0.0,
+                 churn_recover_rate: float = 0.0,
+                 partition_at: int = -1, heal_at: int = -1):
+        self.index = index
+        self.seed = int(seed)
+        self.loss_thr = rate_threshold(packet_loss_rate)
+        self.fail_thr = rate_threshold(churn_fail_rate)
+        self.recover_thr = rate_threshold(churn_recover_rate)
+        self.partition_at = int(partition_at)
+        self.heal_at = int(heal_at)
+        self.side = (stake_bipartition(index.stakes.astype(np.int64))
+                     if partition_at >= 0 else None)
+        # per-round state, set by begin_round()
+        self._edge_basis = 0
+        self._part_on = False
+        self.delivered = 0
+        self.dropped = 0
+        self.suppressed = 0
+
+    @property
+    def has_churn(self) -> bool:
+        return self.fail_thr > 0 or self.recover_thr > 0
+
+    def begin_round(self, it: int) -> None:
+        self._edge_basis = round_basis(self.seed, it, SALT_EDGE)
+        self._part_on = partition_active(it, self.partition_at, self.heal_at)
+        self.delivered = 0
+        self.dropped = 0
+        self.suppressed = 0
+
+    def classify_edge(self, src_pk, dst_pk) -> str:
+        """'delivered' | 'suppressed' (partition) | 'dropped' (loss) for one
+        push to a live target; counts the outcome."""
+        si = self.index.index_of(src_pk)
+        di = self.index.index_of(dst_pk)
+        if self._part_on and self.side[si] != self.side[di]:
+            self.suppressed += 1
+            return "suppressed"
+        if self.loss_thr and edge_u32(self._edge_basis, si, di) < self.loss_thr:
+            self.dropped += 1
+            return "dropped"
+        self.delivered += 1
+        return "delivered"
+
+    def churn_step(self, it: int, node_map, failed_nodes: set) -> tuple:
+        """Flip node failure states for iteration ``it``.
+
+        Alive nodes fail with p_fail, failed nodes recover with p_recover —
+        one hash per node, interpreted against its current state (mirrors the
+        engine's ``jnp.where(failed, ~recover, fail)``).  Updates
+        ``node.failed`` and the ``failed_nodes`` set in place; returns
+        (newly_failed, newly_recovered) pubkey lists."""
+        basis = round_basis(self.seed, it, SALT_CHURN)
+        newly_failed, newly_recovered = [], []
+        for i, pk in enumerate(self.index.pubkeys):
+            node = node_map[pk]
+            u = node_u32(basis, i)
+            if node.failed:
+                if u < self.recover_thr:
+                    node.failed = False
+                    failed_nodes.discard(pk)
+                    newly_recovered.append(pk)
+            elif u < self.fail_thr:
+                node.failed = True
+                failed_nodes.add(pk)
+                newly_failed.append(pk)
+        return newly_failed, newly_recovered

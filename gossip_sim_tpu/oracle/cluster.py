@@ -18,6 +18,11 @@ Divergence from the reference (documented, deliberate): all randomness flows
 through one explicit seeded rng — the reference's entropy-seeded per-thread
 RNGs (gossip.rs:747-753, gossip_main.rs:269) make production runs
 unreproducible and are not carried forward.
+
+Network impairments (packet loss / churn / partition) are driven by an
+optional ``faults.FaultInjector`` whose stateless counter hashes match the
+TPU engine bit-for-bit; ``run_gossip`` takes it as an optional argument and
+the per-round delivered/dropped/suppressed counters live on the injector.
 """
 
 from __future__ import annotations
@@ -96,8 +101,14 @@ class Cluster:
 
     # -- verb 1: push/diffuse ------------------------------------------------
 
-    def run_gossip(self, origin_pubkey, stakes, node_map):
-        """BFS through active sets truncated to fanout (gossip.rs:494-615)."""
+    def run_gossip(self, origin_pubkey, stakes, node_map, impair=None):
+        """BFS through active sets truncated to fanout (gossip.rs:494-615).
+
+        ``impair``: optional ``faults.FaultInjector``.  Partition-suppressed
+        and loss-dropped pushes consume their fanout slot exactly like pushes
+        to failed targets (gossip.rs:538-541) and contribute nothing to
+        delivery, ingress, consume ranking, or RMR's m; the injector counts
+        delivered/dropped/suppressed per round."""
         self._clear(stakes)
         self.distances[origin_pubkey] = 0
         self.visited.add(origin_pubkey)
@@ -114,6 +125,10 @@ class Cluster:
             for _, neighbor in zip(range(fanout), peers):
                 if node_map[neighbor].failed:
                     continue  # failed targets consume a fanout slot, nothing else
+                if (impair is not None
+                        and impair.classify_edge(current, neighbor)
+                        != "delivered"):
+                    continue  # suppressed/dropped: slot consumed, no delivery
                 self.pushes[current].add(neighbor)
                 self.egress_message_count[current] += 1
                 self.ingress_message_count[neighbor] = (
@@ -201,6 +216,12 @@ class Cluster:
         for i in order[:total]:
             nodes[i].fail_node()
             self.failed_nodes.add(nodes[i].pubkey)
+
+    def apply_churn(self, impair, it, node_map):
+        """Per-iteration fail/recover churn (faults.FaultInjector.churn_step);
+        keeps ``failed_nodes`` in sync so stranded stats exclude currently
+        failed nodes.  Returns (newly_failed, newly_recovered) pubkeys."""
+        return impair.churn_step(it, node_map, self.failed_nodes)
 
     # -- observers -----------------------------------------------------------
 

@@ -33,5 +33,10 @@ class RelativeMessageRedundancy:
     def calculate(self):
         if self.n == 0:
             raise ZeroDivisionError("RMR: n is 0")
-        self.rmr = self.m / (self.n - 1) - 1.0
+        if self.n == 1:
+            # only the origin holds the message — delivery collapsed under
+            # impairment (faults.py); the engine reports 0.0 here too
+            self.rmr = 0.0
+        else:
+            self.rmr = self.m / (self.n - 1) - 1.0
         return self.rmr, self.m, self.n

@@ -241,6 +241,33 @@ class InfluxDataPoint:
             self.datapoint += f"{data_type} bucket={bucket_max},count={count} "
             self.set_and_append_timestamp()
 
+    def create_delivery_point(self, delivered, dropped, suppressed,
+                              failed_count):
+        """Degraded-delivery counters under fault injection (faults.py):
+        per-iteration on the single-origin path, run-level means on the
+        all-origins aggregate path."""
+        self.datapoint += (
+            f"delivery,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"delivered={delivered},dropped={dropped},"
+            f"suppressed={suppressed},failed={failed_count} ")
+        self.append_timestamp()
+
+    def create_recovery_point(self, origins, mean_iters, max_iters,
+                              unrecovered):
+        """Iterations-to-recover coverage after a partition heal.
+
+        mean/max cover origins that DID recover; when none did they are
+        0 and ``unrecovered == origins`` disambiguates from an instant
+        recovery (same convention on the single-origin and aggregate
+        paths, and never a NaN on the wire)."""
+        self.datapoint += (
+            f"coverage_recovery,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"origins={origins},mean_iters={mean_iters},"
+            f"max_iters={max_iters},unrecovered={unrecovered} ")
+        self.append_timestamp()
+
     def create_messages_point(self, messages_direction: str, messages,
                               simulation_iter_val: int):
         for bucket, count in messages.items():
@@ -257,17 +284,32 @@ class InfluxDB:
 
     def __init__(self, endpoint: str, username: str, password: str,
                  database: str, tracker: Tracker | None = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, max_retries: int = 3,
+                 retry_base: float = 0.5, max_queue: int = 1024):
         self.url = endpoint.rstrip("/") + "/write"
         self.database = database
         self.username = username
         self.password = password
         self.tracker = tracker
         self.timeout = timeout
+        self.max_retries = max_retries
+        self.retry_base = retry_base
+        self.max_queue = max_queue
+        self.dropped_points = 0   # points lost after retries / queue overflow
         self._send_q = None
         self._send_lock = threading.Lock()
 
+    def _count_dropped(self):
+        with self._send_lock:
+            self.dropped_points += 1
+
     def _post(self, body: str):
+        """POST one line-protocol body; retry transient failures with
+        exponential backoff + jitter, then count the point as dropped.  The
+        tracker is marked sent exactly once either way so the drain loop
+        (InfluxThread) terminates instead of hanging on lost points."""
+        import random
+
         url = f"{self.url}?{urllib.parse.urlencode({'db': self.database})}"
         auth = base64.b64encode(
             f"{self.username}:{self.password}".encode()).decode()
@@ -275,12 +317,35 @@ class InfluxDB:
             url, data=body.encode(),
             headers={"Authorization": f"Basic {auth}"})
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                if not (200 <= resp.status < 300):
-                    log.error("Failed to report data to InfluxDB. Status: %s",
-                              resp.status)
-        except (urllib.error.URLError, OSError) as err:
-            log.error("Error reporting to InfluxDB: %s", err)
+            delay = self.retry_base
+            for attempt in range(self.max_retries + 1):
+                err = None
+                retryable = True
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=self.timeout) as resp:
+                        if 200 <= resp.status < 300:
+                            return
+                        err = f"HTTP status {resp.status}"
+                except urllib.error.HTTPError as exc:
+                    err = f"HTTP status {exc.code}"
+                    # permanent client errors (bad auth, malformed body)
+                    # never succeed on retry — fail fast so a config error
+                    # can't back-pressure the whole send queue
+                    retryable = exc.code >= 500 or exc.code in (408, 429)
+                except (urllib.error.URLError, OSError) as exc:
+                    err = exc
+                if retryable and attempt < self.max_retries:
+                    log.warning("InfluxDB send failed (attempt %s/%s): %s — "
+                                "retrying in %.2fs", attempt + 1,
+                                self.max_retries + 1, err, delay)
+                    time.sleep(delay * (1.0 + 0.5 * random.random()))
+                    delay *= 2
+                else:
+                    self._count_dropped()
+                    log.error("Dropping InfluxDB point after %s attempt(s): "
+                              "%s", attempt + 1, err)
+                    return
         finally:
             if self.tracker is not None:
                 self.tracker.add_sent()
@@ -289,10 +354,12 @@ class InfluxDB:
         # Async send like the reference (one async_std task per point,
         # influx_db.rs:81-96), but through a single persistent worker so a
         # slow endpoint can't accumulate thousands of live sender threads.
+        # The queue is bounded: a stalled endpoint sheds points (counted in
+        # ``dropped_points``) instead of growing without limit.
         with self._send_lock:
             if self._send_q is None:
                 import queue
-                self._send_q = queue.Queue()
+                self._send_q = queue.Queue(maxsize=self.max_queue)
 
                 def _worker():
                     while True:
@@ -306,7 +373,16 @@ class InfluxDB:
                             log.error("influx sender error: %s", err)
 
                 threading.Thread(target=_worker, daemon=True).start()
-        self._send_q.put(datapoint.data())
+        import queue
+        try:
+            self._send_q.put_nowait(datapoint.data())
+        except queue.Full:
+            self._count_dropped()
+            # still mark it sent: the drain tracker must converge
+            if self.tracker is not None:
+                self.tracker.add_sent()
+            log.error("InfluxDB send queue full (%s); dropping point",
+                      self.max_queue)
 
 
 class InfluxThread:
@@ -336,6 +412,10 @@ class InfluxThread:
                     log.info("Last simulation datapoint recorded. "
                              "Draining Queue...")
                 if tracker.equal():
+                    if influx_db.dropped_points:
+                        log.warning("WARNING: %s InfluxDB point(s) dropped "
+                                    "(send failures after retries or queue "
+                                    "overflow)", influx_db.dropped_points)
                     log.info("Queue Drained. Exiting...")
                     break
             time.sleep(wait_time)

@@ -71,8 +71,14 @@ class AllOriginsStats:
         self.coverage_stats = StatCollection("Coverage")
         self.rmr_stats = StatCollection("RMR")
         self.branching_stats = StatCollection("Outbound Branching Factor")
+        self.delivered_stats = StatCollection("Delivered Messages")
+        self.dropped_stats = StatCollection("Dropped Messages")
+        self.suppressed_stats = StatCollection("Suppressed Messages")
+        self.failed_stats = StatCollection("Failed Nodes")
         self._chunks = {"coverage": [], "rmr": [], "branching": [],
-                        "ldh": []}   # per-batch [measured*O] arrays
+                        "ldh": [], "delivered": [], "dropped": [],
+                        "suppressed": [],
+                        "failed": []}   # per-batch [measured*O] arrays
         self.hops_hist = np.zeros(hist_bins, np.int64)
         self.stranded_counts = np.zeros(self.N, np.int64)
         self.egress = np.zeros(self.N, np.int64)
@@ -82,6 +88,13 @@ class AllOriginsStats:
         self.num_origins = 0
         self.inb_dropped = 0
         self.rc_overflow = 0
+        self.hop_clamped = 0             # hops clamped into the top bin
+        self.total_dropped = 0           # loss-dropped messages (measured)
+        self.total_suppressed = 0        # partition-suppressed (measured)
+        self.impaired = False            # set by finalize(config)
+        # per-origin iterations-to-recover coverage after heal (faults.py);
+        # -1 = that origin never recovered within the run
+        self.recovery_iters = []
         # filled by finalize():
         self.aggregate_hops = HopsStat()
         self.ldh_stats = HopsStat()
@@ -93,9 +106,16 @@ class AllOriginsStats:
 
     # -- per-batch accumulation -------------------------------------------
 
-    def add_batch(self, rows, state, warm_up_rounds: int):
+    def add_batch(self, rows, state, warm_up_rounds: int, heal_at: int = -1,
+                  impaired: bool = False):
         """Fold one origin batch's rows (leading [iters] axis) + final
-        SimState accumulators (already warm-up-gated on device)."""
+        SimState accumulators (already warm-up-gated on device).
+
+        ``heal_at`` >= 0 additionally extracts per-origin
+        iterations-to-recover-coverage from the full (unwarmed) coverage
+        series.  ``impaired`` gates the delivery-counter accumulation —
+        the engine always emits the counter rows (all-zero when the knobs
+        are off), so unimpaired runs must not retain them."""
         cov = np.asarray(rows["coverage"])[warm_up_rounds:]
         if cov.size:
             self._chunks["coverage"].append(
@@ -109,6 +129,34 @@ class AllOriginsStats:
             self._chunks["ldh"].append(
                 np.asarray(rows["hop_max"])[warm_up_rounds:]
                 .ravel().astype(np.int64))
+            if impaired:
+                for key, row_key in (("delivered", "delivered"),
+                                     ("dropped", "dropped"),
+                                     ("suppressed", "suppressed"),
+                                     ("failed", "failed_count")):
+                    self._chunks[key].append(
+                        np.asarray(rows[row_key])[warm_up_rounds:]
+                        .ravel().astype(np.float64))
+        if impaired:
+            self.total_dropped += int(
+                np.asarray(rows["dropped"])[warm_up_rounds:].sum())
+            self.total_suppressed += int(
+                np.asarray(rows["suppressed"])[warm_up_rounds:].sum())
+        if "hop_clamped" in rows:
+            # measured rounds only, matching the warm-up-gated hops
+            # histogram this guard is about (and the single-origin path)
+            self.hop_clamped += int(
+                np.asarray(rows["hop_clamped"])[warm_up_rounds:].sum())
+        if heal_at >= 0:
+            from ..constants import COVERAGE_RECOVERY_THRESHOLD
+            cov_full = np.asarray(rows["coverage"])       # [iters, O]
+            after = cov_full[heal_at:] >= COVERAGE_RECOVERY_THRESHOLD
+            if after.shape[0]:
+                hit = after.any(axis=0)
+                first = after.argmax(axis=0)
+                self.recovery_iters.extend(
+                    int(first[o]) if hit[o] else -1
+                    for o in range(after.shape[1]))
         self.hops_hist += np.asarray(state.hops_hist_acc,
                                      dtype=np.int64).sum(axis=0)
         self.stranded_counts += np.asarray(state.stranded_acc,
@@ -137,6 +185,7 @@ class AllOriginsStats:
         sc.min = float(arr.min())
 
     def finalize(self, config):
+        self.impaired = config.impairments_on
         cov = np.concatenate(self._chunks["coverage"]) if \
             self._chunks["coverage"] else np.empty(0)
         self._fill_stat_collection(self.coverage_stats, cov)
@@ -148,6 +197,13 @@ class AllOriginsStats:
             self.branching_stats,
             np.concatenate(self._chunks["branching"])
             if self._chunks["branching"] else np.empty(0))
+        for sc, key in ((self.delivered_stats, "delivered"),
+                        (self.dropped_stats, "dropped"),
+                        (self.suppressed_stats, "suppressed"),
+                        (self.failed_stats, "failed")):
+            self._fill_stat_collection(
+                sc, np.concatenate(self._chunks[key])
+                if self._chunks[key] else np.empty(0))
         self.aggregate_hops = HistogramHopsStat(self.hops_hist)
         # LDH = HopsStat over per-round maxima (gossip_stats.rs:196-210):
         # filter 0 (rounds where nobody beyond the origin was reached)
@@ -200,6 +256,23 @@ class AllOriginsStats:
                                     stakes_map)
             tracker.normalize_message_counts()
 
+    def recovery_summary(self):
+        """Aggregate iterations-to-recover-coverage after heal, or None when
+        no heal was configured.  ``-1`` entries (never recovered) are counted
+        in ``unrecovered`` and excluded from mean/max; with zero recoveries
+        mean/max are 0 (``unrecovered == origins`` disambiguates — and the
+        Influx line protocol rejects NaN fields)."""
+        if not self.recovery_iters:
+            return None
+        arr = np.asarray(self.recovery_iters, np.int64)
+        ok = arr[arr >= 0]
+        return {
+            "origins": int(arr.size),
+            "unrecovered": int((arr < 0).sum()),
+            "mean": float(ok.mean()) if ok.size else 0.0,
+            "max": int(ok.max()) if ok.size else 0,
+        }
+
     # -- output -----------------------------------------------------------
 
     def _print_sc(self, sc):
@@ -246,6 +319,22 @@ class AllOriginsStats:
         log.info("Total stranded nodes: %s", c.stranded_count())
         log.info("|---- OUTBOUND BRANCHING FACTOR ----|")
         self._print_sc(self.branching_stats)
+        if self.impaired:
+            log.info("|---- DEGRADED DELIVERY STATS ----|")
+            for sc in (self.delivered_stats, self.dropped_stats,
+                       self.suppressed_stats, self.failed_stats):
+                self._print_sc(sc)
+            log.info("Total dropped: %s  Total suppressed: %s",
+                     self.total_dropped, self.total_suppressed)
+        rec = self.recovery_summary()
+        if rec is not None:
+            log.info("|---- COVERAGE RECOVERY AFTER HEAL ----|")
+            log.info("Origins: %s  Unrecovered: %s  Mean iters: %.2f  "
+                     "Max iters: %s", rec["origins"], rec["unrecovered"],
+                     rec["mean"], rec["max"])
+        if self.hop_clamped:
+            log.info("Hop histogram top-bin clamped samples: %s",
+                     self.hop_clamped)
 
     def emit_influx(self, dp_queue, start_ts: str):
         """Aggregate versions of the reference series
@@ -277,4 +366,12 @@ class AllOriginsStats:
                                  self.ingress_tracker.histogram, 0)
         dp.create_messages_point("prune_message_count",
                                  self.prune_tracker.histogram, 0)
+        if self.impaired:
+            dp.create_delivery_point(
+                self.delivered_stats.mean, self.dropped_stats.mean,
+                self.suppressed_stats.mean, self.failed_stats.mean)
+        rec = self.recovery_summary()
+        if rec is not None:
+            dp.create_recovery_point(rec["origins"], rec["mean"],
+                                     rec["max"], rec["unrecovered"])
         dp_queue.push_back(dp)

@@ -34,6 +34,11 @@ class SimulationParameters:
     min_ingress_nodes: int = 0
     fraction_to_fail: float = 0.0
     when_to_fail: int = 0
+    packet_loss_rate: float = 0.0
+    churn_fail_rate: float = 0.0
+    churn_recover_rate: float = 0.0
+    partition_at: int = -1
+    heal_at: int = -1
     test_type: Testing = Testing.NO_TEST
     num_simulations: int = 0
     step_size: StepSize = field(default_factory=lambda: StepSize(0, True))
@@ -53,6 +58,20 @@ class GossipStats:
         self.ingress_messages = EgressIngressMessageTracker()
         self.prune_messages = EgressIngressMessageTracker()
         self.validator_stake_distribution = Histogram()
+        # degraded-delivery series (faults.py); empty unless impairments ran
+        self.delivered_stats = StatCollection("Delivered Messages")
+        self.dropped_stats = StatCollection("Dropped Messages")
+        self.suppressed_stats = StatCollection("Suppressed Messages")
+        self.failed_count_series = []
+        # iterations from heal_at until coverage regained the recovery
+        # threshold; None = no heal configured or never measured, -1 = never
+        # recovered within the run
+        self.recovery_iterations = None
+        # full post-heal (iteration, coverage) samples — fed by both
+        # backends for every iteration >= heal_at including warm-up rounds,
+        # so the metric is iteration-exact and agrees with the all-origins
+        # aggregate path (stats/aggregate.py add_batch)
+        self._post_heal_coverage = []
 
     # -- setup ---------------------------------------------------------------
 
@@ -67,6 +86,11 @@ class GossipStats:
             min_ingress_nodes=config.min_ingress_nodes,
             fraction_to_fail=config.fraction_to_fail,
             when_to_fail=config.when_to_fail,
+            packet_loss_rate=config.packet_loss_rate,
+            churn_fail_rate=config.churn_fail_rate,
+            churn_recover_rate=config.churn_recover_rate,
+            partition_at=config.partition_at,
+            heal_at=config.heal_at,
             test_type=config.test_type,
             num_simulations=config.num_simulations,
             step_size=config.step_size,
@@ -113,6 +137,42 @@ class GossipStats:
     def update_prune_counts(self, prunes):
         self.prune_messages.update_message_counts(prunes)
 
+    def insert_delivery(self, delivered, dropped, suppressed, failed_count):
+        """Per-round degraded-delivery counters (faults.py)."""
+        self.delivered_stats.push(delivered)
+        self.dropped_stats.push(dropped)
+        self.suppressed_stats.push(suppressed)
+        self.failed_count_series.append(int(failed_count))
+
+    def has_delivery_stats(self):
+        return not self.delivered_stats.is_empty()
+
+    def note_post_heal_coverage(self, it, coverage):
+        """Record one post-heal (iteration, coverage) sample.  Both backends
+        feed every iteration >= heal_at — warm-up rounds included — so the
+        recovery metric below sees the true iteration axis."""
+        self._post_heal_coverage.append((int(it), float(coverage)))
+
+    def calc_recovery_iterations(self, heal_at, threshold=None):
+        """Iterations after ``heal_at`` until coverage regains ``threshold``
+        (COVERAGE_RECOVERY_THRESHOLD by default), measured on the full
+        post-heal series — 0 means coverage was already at the bar on the
+        heal iteration itself, matching the all-origins aggregate path.
+        Sets ``recovery_iterations`` (-1 = never recovered in this run)."""
+        from ..constants import COVERAGE_RECOVERY_THRESHOLD
+        if threshold is None:
+            threshold = COVERAGE_RECOVERY_THRESHOLD
+        if heal_at < 0 or not self._post_heal_coverage:
+            self.recovery_iterations = None
+            return None
+        for it, cov in self._post_heal_coverage:
+            if cov >= threshold:
+                self.recovery_iterations = it - heal_at
+                break
+        else:
+            self.recovery_iterations = -1
+        return self.recovery_iterations
+
     # -- end-of-simulation ---------------------------------------------------
 
     def build_stranded_node_histogram(self, upper_bound, lower_bound, num_buckets):
@@ -147,6 +207,13 @@ class GossipStats:
         self.hops_stats.calc_last_delivery_hop_stats()
         self.stranded_node_collection.calculate_stats()
         self.outbound_branching_factors.calculate_stats()
+        if self.has_delivery_stats():
+            self.delivered_stats.calculate_stats()
+            self.dropped_stats.calculate_stats()
+            self.suppressed_stats.calculate_stats()
+        sp = self.simulation_parameters
+        if sp.heal_at >= 0:
+            self.calc_recovery_iterations(sp.heal_at)
 
     # -- accessors -----------------------------------------------------------
 
@@ -274,6 +341,20 @@ class GossipStats:
         log.info("|---- OUTBOUND BRANCHING FACTOR ----|")
         self._print_stat_collection(self.outbound_branching_factors)
         self._print_histogram("EGRESS MESSAGES", self.egress_messages.histogram)
+        if self.has_delivery_stats():
+            log.info("|---- DEGRADED DELIVERY STATS ----|")
+            for sc in (self.delivered_stats, self.dropped_stats,
+                       self.suppressed_stats):
+                self._print_stat_collection(sc)
+            if self.failed_count_series:
+                log.info("Failed nodes (last measured round): %s",
+                         self.failed_count_series[-1])
+        if self.recovery_iterations is not None:
+            if self.recovery_iterations >= 0:
+                log.info("Coverage recovered %s iteration(s) after heal",
+                         self.recovery_iterations)
+            else:
+                log.info("Coverage did NOT recover after heal within the run")
 
 
 class GossipStatsCollection:

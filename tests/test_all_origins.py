@@ -60,3 +60,33 @@ def test_all_origins_single_device_unsharded():
     summary = run_all_origins(cfg, "", accounts=accounts)
     assert summary["mesh_devices"] == 1
     assert summary["measured_points"] == 2 * 32
+
+
+def test_all_origins_churn_only_keeps_delivery_stats():
+    """Churn alone (no loss, no partition) drops/suppresses nothing, but the
+    run is still impaired: the delivery distributions must be populated and
+    flagged for output (stats/aggregate.py gates on the config, not on the
+    drop totals)."""
+    accounts = _accounts(32, seed=5)
+    cfg = Config(gossip_iterations=8, warm_up_rounds=4, all_origins=True,
+                 origin_batch=0, mesh_devices=1, churn_fail_rate=0.05,
+                 churn_recover_rate=0.3, seed=2)
+    summary = run_all_origins(cfg, "", accounts=accounts)
+    agg = summary["stats"]
+    assert agg.impaired
+    assert agg.delivered_stats.mean > 0
+    # churn holds a nonzero failed population in the aggregate series
+    assert agg.failed_stats.mean > 0
+    assert agg.total_dropped == 0 and agg.total_suppressed == 0
+
+
+def test_all_origins_unimpaired_not_flagged():
+    accounts = _accounts(24, seed=6)
+    cfg = Config(gossip_iterations=6, warm_up_rounds=4, all_origins=True,
+                 origin_batch=0, mesh_devices=1)
+    summary = run_all_origins(cfg, "", accounts=accounts)
+    agg = summary["stats"]
+    assert not agg.impaired
+    # the engine always emits the (all-zero) counter rows; an unimpaired
+    # run must not retain them
+    assert agg.delivered_stats.is_empty()

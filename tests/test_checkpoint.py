@@ -85,6 +85,113 @@ def test_v1_checkpoint_backfills_derived_fields(tmp_path):
             np.asarray(getattr(state, f)), err_msg=f)
 
 
+def test_save_is_atomic_on_failure(tmp_path, monkeypatch):
+    """A crash mid-save must never corrupt an existing checkpoint: the write
+    goes to a temp file and only an os.replace publishes it."""
+    import gossip_sim_tpu.checkpoint as cp
+
+    params, tables, origins, state = _setup()
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, params)
+    good = (tmp_path / "ckpt.npz").read_bytes()
+
+    def _boom(*a, **kw):
+        raise OSError("disk full")
+    monkeypatch.setattr(cp.np, "savez_compressed", _boom)
+    with pytest.raises(OSError, match="disk full"):
+        save_state(path, state, params, iteration=9)
+    # the prior checkpoint is untouched and no temp droppings remain
+    assert (tmp_path / "ckpt.npz").read_bytes() == good
+    assert [p.name for p in tmp_path.iterdir()] == ["ckpt.npz"]
+
+
+def test_v3_checkpoint_records_impair_block(tmp_path):
+    params, tables, origins, state = _setup()
+    params = params._replace(packet_loss_rate=0.25, churn_fail_rate=0.01,
+                             churn_recover_rate=0.5, partition_at=3,
+                             heal_at=8, impair_seed=77)
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, params, iteration=4)
+    _, _, meta = restore_sim_state(path, params)
+    assert meta["format_version"] == 3
+    assert meta["impair"] == {
+        "packet_loss_rate": 0.25, "churn_fail_rate": 0.01,
+        "churn_recover_rate": 0.5, "partition_at": 3, "heal_at": 8,
+        "impair_seed": 77}
+
+
+def test_v2_checkpoint_backfills_all_off_impair(tmp_path):
+    """Pre-fault-subsystem checkpoints carry no impair block; loading must
+    backfill the all-off defaults and stay resumable."""
+    import json
+
+    params, tables, origins, state = _setup()
+    state, _ = run_rounds(params, tables, origins, state, 3)
+    path = str(tmp_path / "v2.npz")
+    arrays = {f"state.{f}": np.asarray(getattr(state, f))
+              for f in state._fields}
+    pdict = {k: v for k, v in params._asdict().items()
+             if k not in ("packet_loss_rate", "churn_fail_rate",
+                          "churn_recover_rate", "partition_at", "heal_at",
+                          "impair_seed")}
+    meta = {"format_version": 2, "params": pdict, "iteration": 3}
+    np.savez_compressed(path, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+
+    restored, _, meta2 = restore_sim_state(path, params)
+    assert meta2["impair"] == {
+        "packet_loss_rate": 0.0, "churn_fail_rate": 0.0,
+        "churn_recover_rate": 0.0, "partition_at": -1, "heal_at": -1,
+        "impair_seed": 0}
+    # and the restored state continues bit-identically
+    cont, _ = run_rounds(params, tables, origins, state, 2, start_it=3)
+    res, _ = run_rounds(params, tables, origins, restored, 2, start_it=3)
+    for f in cont._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(cont, f)),
+                                      np.asarray(getattr(res, f)), err_msg=f)
+
+
+def test_roundtrip_resume_mid_churn_bit_identical(tmp_path):
+    """Checkpoint taken mid-churn (nodes failed and recovering, partition
+    open, loss active): because impairment decisions are stateless counter
+    hashes of (seed, iteration, ids), a resume from the stored failed mask +
+    iteration must be bit-exact with the uninterrupted run."""
+    params, tables, origins, state = _setup()
+    params = params._replace(packet_loss_rate=0.1, churn_fail_rate=0.05,
+                             churn_recover_rate=0.3, partition_at=1,
+                             heal_at=9, impair_seed=13)
+    state, _ = run_rounds(params, tables, origins, state, 5)
+    assert np.asarray(state.failed).any(), "churn regime must be mid-flight"
+    path = str(tmp_path / "churn.npz")
+    save_state(path, state, params, iteration=5)
+
+    cont_state, cont_rows = run_rounds(params, tables, origins, state, 6,
+                                       start_it=5)
+    restored, _, meta = restore_sim_state(path, params)
+    assert meta["iteration"] == 5
+    res_state, res_rows = run_rounds(params, tables, origins, restored, 6,
+                                     start_it=5)
+    for k in cont_rows:
+        np.testing.assert_array_equal(np.asarray(cont_rows[k]),
+                                      np.asarray(res_rows[k]), err_msg=k)
+    for f in cont_state._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(cont_state, f)),
+                                      np.asarray(getattr(res_state, f)),
+                                      err_msg=f)
+
+
+def test_impair_knob_mismatch_warns_on_resume(tmp_path, caplog):
+    import logging
+
+    params, tables, origins, state = _setup()
+    saved = params._replace(packet_loss_rate=0.2, impair_seed=3)
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, saved)
+    with caplog.at_level(logging.WARNING):
+        restore_sim_state(path, saved._replace(packet_loss_rate=0.4))
+    assert any("impairment schedule" in r.message for r in caplog.records)
+
+
 def test_cli_kill_and_resume_bit_identical(tmp_path):
     """VERDICT r4 #6: a straight 16-iteration CLI run and a 10-iteration run
     killed + resumed to 16 must land on bit-identical final states."""

@@ -1,0 +1,371 @@
+"""Fault-injection subsystem tests (faults.py): hash-primitive parity
+between the scalar (oracle) and vectorized (engine) paths, bipartition
+determinism, reference-parity gating, and EXACT oracle-vs-engine parity
+under packet loss + continuous churn + a healing partition.
+
+The parity harness reuses the forced-active-set technique from
+tests/test_engine.py: with rotation off and the oracle's active sets copied
+from the engine's sampled ones, both backends are fully deterministic, so
+the delivered set (distances), per-round failed masks, and the
+delivered/dropped/suppressed counters must match bit-for-bit."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_sim_tpu.constants import UNREACHED
+from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                   make_cluster_tables, run_rounds)
+from gossip_sim_tpu.faults import (SALT_CHURN, SALT_EDGE, FaultInjector,
+                                   edge_u32, edge_u32_arr, fmix32, fmix32_arr,
+                                   node_u32, node_u32_arr, partition_active,
+                                   rate_threshold, round_basis,
+                                   round_basis_arr, stake_bipartition)
+from gossip_sim_tpu.identity import (NodeIndex, get_stake_bucket,
+                                     pubkey_new_unique)
+from gossip_sim_tpu.oracle.cluster import Cluster, Node
+
+
+# --------------------------------------------------------------------------
+# hash primitives: scalar path == numpy path == jax path, bit for bit
+# --------------------------------------------------------------------------
+
+class TestHashPrimitives:
+    def test_fmix32_scalar_matches_arrays(self):
+        xs = np.random.default_rng(0).integers(0, 1 << 32, 256,
+                                               dtype=np.uint32)
+        scalar = np.array([fmix32(int(x)) for x in xs], dtype=np.uint64)
+        np.testing.assert_array_equal(
+            scalar, fmix32_arr(xs, np).astype(np.uint64))
+        np.testing.assert_array_equal(
+            scalar, np.asarray(fmix32_arr(jnp.asarray(xs), jnp),
+                               dtype=np.uint64))
+
+    def test_edge_and_node_hashes_match_vectorized(self):
+        basis = round_basis(42, 7, SALT_EDGE)
+        src = np.arange(64, dtype=np.uint32)
+        dst = np.arange(64, dtype=np.uint32)[::-1].copy()
+        scalar = np.array([edge_u32(basis, int(s), int(d))
+                           for s, d in zip(src, dst)], dtype=np.uint64)
+        np.testing.assert_array_equal(
+            scalar,
+            edge_u32_arr(np.uint32(basis), src, dst, np).astype(np.uint64))
+        np.testing.assert_array_equal(
+            scalar,
+            np.asarray(edge_u32_arr(jnp.uint32(basis), jnp.asarray(src),
+                                    jnp.asarray(dst), jnp),
+                       dtype=np.uint64))
+
+        basis_c = round_basis(42, 7, SALT_CHURN)
+        scalar_n = np.array([node_u32(basis_c, int(i)) for i in src],
+                            dtype=np.uint64)
+        np.testing.assert_array_equal(
+            scalar_n,
+            node_u32_arr(np.uint32(basis_c), src, np).astype(np.uint64))
+
+    def test_round_basis_traced_iteration_matches_scalar(self):
+        """The engine hands a traced int32 iteration into round_basis_arr;
+        the result must equal the oracle's pure-int basis."""
+        def f(it):
+            return round_basis_arr(9, it, SALT_EDGE, jnp)
+        for it in (0, 1, 17, 4095):
+            assert int(jax.jit(f)(jnp.int32(it))) == round_basis(
+                9, it, SALT_EDGE)
+
+    def test_rate_threshold_endpoints(self):
+        assert rate_threshold(0.0) == 0
+        assert rate_threshold(-1.0) == 0
+        assert rate_threshold(1.0) == 1 << 32
+        assert rate_threshold(2.0) == 1 << 32
+        # strictly monotone interior and always hit/miss at the endpoints
+        assert 0 < rate_threshold(0.25) < rate_threshold(0.75) < (1 << 32)
+        assert (1 << 32) - 1 < rate_threshold(1.0)  # max u32 still fires
+
+    def test_partition_active_window(self):
+        assert not partition_active(5, -1, -1)
+        assert partition_active(5, 5, -1)
+        assert not partition_active(4, 5, -1)
+        assert partition_active(7, 5, 8)
+        assert not partition_active(8, 5, 8)
+
+    def test_stake_bipartition_balanced_and_deterministic(self):
+        rng = np.random.default_rng(3)
+        stakes = rng.integers(1, 1 << 40, 501, dtype=np.int64)
+        side = stake_bipartition(stakes)
+        side2 = stake_bipartition(stakes)
+        np.testing.assert_array_equal(side, side2)
+        s0 = int(stakes[~side].sum())
+        s1 = int(stakes[side].sum())
+        # greedy balance: the gap never exceeds the largest single stake
+        assert abs(s0 - s1) <= int(stakes.max())
+        assert 0 < side.sum() < len(stakes)
+
+
+# --------------------------------------------------------------------------
+# reference-parity gating: all-off knobs compile the identical round
+# --------------------------------------------------------------------------
+
+def test_default_params_have_no_impairments():
+    p = EngineParams(num_nodes=16)
+    assert not p.has_impairments
+    assert not p.has_churn
+
+
+def test_engine_unimpaired_rows_identical_with_zero_knobs():
+    """Explicit zero knobs and the defaults select the same compiled round:
+    every row (including the new counters) must match bit-for-bit, and the
+    impairment counters stay zero."""
+    rng = np.random.default_rng(5)
+    stakes = rng.choice(np.arange(1, 5000), 80, replace=False).astype(
+        np.int64) * 10**9
+    tables = make_cluster_tables(stakes)
+    origins = jnp.arange(2, dtype=jnp.int32)
+    base = EngineParams(num_nodes=80, warm_up_rounds=0)
+    explicit = base._replace(packet_loss_rate=0.0, churn_fail_rate=0.0,
+                             churn_recover_rate=0.0, partition_at=-1,
+                             heal_at=-1, impair_seed=123)
+    out = {}
+    for name, params in (("default", base), ("explicit", explicit)):
+        state = init_state(jax.random.PRNGKey(2), tables, origins, params)
+        _, rows = run_rounds(params, tables, origins, state, 8)
+        out[name] = jax.tree_util.tree_map(np.asarray, rows)
+    assert set(out["default"]) == set(out["explicit"])
+    for k in out["default"]:
+        np.testing.assert_array_equal(out["default"][k], out["explicit"][k],
+                                      err_msg=k)
+    assert (out["default"]["dropped"] == 0).all()
+    assert (out["default"]["suppressed"] == 0).all()
+    np.testing.assert_array_equal(out["default"]["delivered"],
+                                  out["default"]["m"])
+
+
+def test_params_validation():
+    with pytest.raises(AssertionError, match="impairment rates"):
+        EngineParams(num_nodes=16, packet_loss_rate=1.5).validate()
+    with pytest.raises(AssertionError, match="heal_at"):
+        EngineParams(num_nodes=16, partition_at=10, heal_at=5).validate()
+
+
+# --------------------------------------------------------------------------
+# oracle-vs-engine bit-exact parity under loss + churn + partition
+# --------------------------------------------------------------------------
+
+class TestFaultParity:
+    """>= 1k nodes, shared seeds, forced-identical active sets, rotation
+    off: delivered set, hop counts, failed masks, and the degraded-delivery
+    counters must match bit-for-bit every round."""
+
+    N = 1024
+    ROUNDS = 8
+    SEED = 99
+    KNOBS = dict(packet_loss_rate=0.15, churn_fail_rate=0.02,
+                 churn_recover_rate=0.25, partition_at=2, heal_at=5)
+
+    @pytest.fixture()
+    def pair(self):
+        n = self.N
+        rng = np.random.default_rng(17)
+        stakes_arr = rng.choice(np.arange(1, 50 * n), size=n,
+                                replace=False).astype(np.int64) * 10**9
+        accounts = {pubkey_new_unique(): int(s) for s in stakes_arr}
+        index = NodeIndex.from_stakes(accounts)
+        stakes_np = index.stakes.astype(np.int64)
+
+        tables = make_cluster_tables(stakes_np)
+        params = EngineParams(num_nodes=n, probability_of_rotation=0.0,
+                              warm_up_rounds=0, impair_seed=self.SEED,
+                              **self.KNOBS).validate()
+        origin_idx = 0
+        origins = jnp.asarray([origin_idx], jnp.int32)
+        state = init_state(jax.random.PRNGKey(11), tables, origins, params)
+
+        stakes_map = {pk: int(s) for pk, s in zip(index.pubkeys, stakes_np)}
+        nodes = [Node(pk, stakes_map[pk]) for pk in index.pubkeys]
+        origin_pk = index.pubkeys[origin_idx]
+        active = np.asarray(state.active[0])
+        for i, node in enumerate(nodes):
+            bucket = get_stake_bucket(min(stakes_map[node.pubkey],
+                                          stakes_map[origin_pk]))
+            entry = node.active_set.entries[bucket]
+            entry.peers = {index.pubkeys[j]: {index.pubkeys[j]}
+                           for j in active[i] if j < n}
+        return (index, stakes_map, nodes, origin_pk,
+                tables, params, origins, state)
+
+    def test_exact_parity_under_faults(self, pair):
+        (index, stakes_map, nodes, origin_pk,
+         tables, params, origins, state) = pair
+        n = self.N
+        node_map = {nd.pubkey: nd for nd in nodes}
+        cluster = Cluster(params.push_fanout)
+        impair = FaultInjector(index, seed=self.SEED, **self.KNOBS)
+        assert impair.has_churn
+
+        state, rows = run_rounds(params, tables, origins, state,
+                                 self.ROUNDS, detail=True)
+        dist_e = np.asarray(rows["dist"])[:, 0]          # [rounds, N]
+        failed_e = np.asarray(rows["failed_mask"])[:, 0]  # [rounds, N]
+        m_e = np.asarray(rows["m"])[:, 0]
+        n_e = np.asarray(rows["n"])[:, 0]
+        delivered_e = np.asarray(rows["delivered"])[:, 0]
+        dropped_e = np.asarray(rows["dropped"])[:, 0]
+        suppressed_e = np.asarray(rows["suppressed"])[:, 0]
+        failed_cnt_e = np.asarray(rows["failed_count"])[:, 0]
+
+        saw_drop = saw_sup = saw_churn = False
+        for r in range(self.ROUNDS):
+            impair.begin_round(r)
+            newly_failed, newly_recovered = impair.churn_step(
+                r, node_map, cluster.failed_nodes)
+            saw_churn |= bool(newly_failed or newly_recovered)
+            cluster.run_gossip(origin_pk, stakes_map, node_map, impair)
+            cluster.consume_messages(origin_pk, nodes)
+            cluster.send_prunes(origin_pk, nodes,
+                                params.prune_stake_threshold,
+                                params.min_ingress_nodes, stakes_map)
+
+            failed_o = np.array([node_map[pk].failed
+                                 for pk in index.pubkeys])
+            np.testing.assert_array_equal(
+                failed_e[r], failed_o,
+                err_msg=f"failed mask diverges at round {r}")
+            assert failed_cnt_e[r] == failed_o.sum()
+
+            dist_o = np.array(
+                [-1 if cluster.distances[pk] == UNREACHED
+                 else cluster.distances[pk] for pk in index.pubkeys])
+            np.testing.assert_array_equal(
+                dist_e[r], dist_o,
+                err_msg=f"distances diverge at round {r}")
+            assert m_e[r] == cluster.rmr.m, f"m diverges at round {r}"
+            assert n_e[r] == cluster.rmr.n, f"n diverges at round {r}"
+            assert delivered_e[r] == impair.delivered, f"round {r}"
+            assert dropped_e[r] == impair.dropped, f"round {r}"
+            assert suppressed_e[r] == impair.suppressed, f"round {r}"
+            saw_drop |= impair.dropped > 0
+            saw_sup |= impair.suppressed > 0
+            # partition window: suppression only inside [partition_at, heal_at)
+            if not (self.KNOBS["partition_at"] <= r < self.KNOBS["heal_at"]):
+                assert suppressed_e[r] == 0
+            cluster.prune_connections(node_map, stakes_map)
+
+        # the regime actually exercised every fault class
+        assert saw_drop and saw_sup and saw_churn
+
+
+class TestFaultParityLossOnly(TestFaultParity):
+    """Loss without churn/partition takes the cheaper compiled path
+    (no tfail rebuild, no side gather); parity must still hold."""
+
+    N = 1024
+    ROUNDS = 6
+    SEED = 7
+    KNOBS = dict(packet_loss_rate=0.3, churn_fail_rate=0.0,
+                 churn_recover_rate=0.0, partition_at=-1, heal_at=-1)
+
+    def test_exact_parity_under_faults(self, pair):
+        (index, stakes_map, nodes, origin_pk,
+         tables, params, origins, state) = pair
+        node_map = {nd.pubkey: nd for nd in nodes}
+        cluster = Cluster(params.push_fanout)
+        impair = FaultInjector(index, seed=self.SEED, **self.KNOBS)
+        assert not impair.has_churn
+
+        state, rows = run_rounds(params, tables, origins, state,
+                                 self.ROUNDS, detail=True)
+        dist_e = np.asarray(rows["dist"])[:, 0]
+        dropped_e = np.asarray(rows["dropped"])[:, 0]
+        suppressed_e = np.asarray(rows["suppressed"])[:, 0]
+        for r in range(self.ROUNDS):
+            impair.begin_round(r)
+            cluster.run_gossip(origin_pk, stakes_map, node_map, impair)
+            cluster.consume_messages(origin_pk, nodes)
+            cluster.send_prunes(origin_pk, nodes,
+                                params.prune_stake_threshold,
+                                params.min_ingress_nodes, stakes_map)
+            dist_o = np.array(
+                [-1 if cluster.distances[pk] == UNREACHED
+                 else cluster.distances[pk] for pk in index.pubkeys])
+            np.testing.assert_array_equal(
+                dist_e[r], dist_o,
+                err_msg=f"distances diverge at round {r}")
+            assert dropped_e[r] == impair.dropped, f"round {r}"
+            assert suppressed_e[r] == 0 and impair.suppressed == 0
+            cluster.prune_connections(node_map, stakes_map)
+        assert dropped_e.sum() > 0
+
+
+# --------------------------------------------------------------------------
+# engine-level fault behavior
+# --------------------------------------------------------------------------
+
+def _engine(n=256, seed=2, rounds=20, **kw):
+    rng = np.random.default_rng(seed)
+    stakes = rng.choice(np.arange(1, 50 * n), size=n,
+                        replace=False).astype(np.int64) * 10**9
+    tables = make_cluster_tables(stakes)
+    params = EngineParams(num_nodes=n, warm_up_rounds=0, **kw).validate()
+    origins = jnp.arange(1, dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(seed), tables, origins, params)
+    state, rows = run_rounds(params, tables, origins, state, rounds)
+    return params, state, jax.tree_util.tree_map(np.asarray, rows)
+
+
+def test_partition_heals_and_coverage_recovers():
+    _, _, rows = _engine(partition_at=2, heal_at=10, rounds=16)
+    cov = rows["coverage"][:, 0]
+    sup = rows["suppressed"][:, 0]
+    assert (sup[2:10] > 0).all(), "partition suppresses cross-edges"
+    assert sup[:2].sum() == 0 and sup[10:].sum() == 0
+    # a bipartition caps delivery near the origin's side; post-heal coverage
+    # must recover to the unimpaired level
+    assert cov[2:10].max() < 0.9
+    assert cov[-1] > 0.99
+
+
+def test_churn_reaches_fail_recover_equilibrium():
+    p, state, rows = _engine(churn_fail_rate=0.1, churn_recover_rate=0.3,
+                             rounds=60)
+    failed = rows["failed_count"][:, 0]
+    assert failed[0] > 0 or failed[1] > 0
+    # stationary failed fraction ~ p_f / (p_f + p_r) = 0.25
+    tail = failed[20:].mean() / p.num_nodes
+    assert 0.1 < tail < 0.4
+    # recovered nodes rejoin: the failed set actually shrinks sometimes
+    assert (np.diff(failed.astype(int)) < 0).any()
+
+
+def test_packet_loss_scales_with_rate():
+    drops = {}
+    for rate in (0.1, 0.5):
+        _, _, rows = _engine(packet_loss_rate=rate, rounds=12, seed=4)
+        d = rows["dropped"][:, 0].sum()
+        t = d + rows["delivered"][:, 0].sum()
+        drops[rate] = d / t
+    assert drops[0.1] == pytest.approx(0.1, abs=0.04)
+    assert drops[0.5] == pytest.approx(0.5, abs=0.06)
+
+
+def test_hop_clamp_counter_counts_top_bin():
+    """hist_bins=4 forces hop distances >= 3 into the clamp guard."""
+    _, _, rows = _engine(n=256, hist_bins=4, rounds=3)
+    clamped = rows["hop_clamped"][:, 0]
+    cov = rows["coverage"][:, 0]
+    # a 256-node fanout-6 BFS needs > 3 hops: the guard must fire
+    assert cov[-1] > 0.9
+    assert clamped.sum() > 0
+
+
+def test_oracle_rmr_handles_total_delivery_collapse():
+    """Heavy impairment can leave only the origin holding the message
+    (n == 1); the oracle must report rmr = 0.0 like the engine instead of
+    dividing by zero.  n == 0 (run_gossip never ran) still raises."""
+    from gossip_sim_tpu.oracle.rmr import RelativeMessageRedundancy
+
+    r = RelativeMessageRedundancy()
+    r.increment_n()
+    r.increment_m_by(3)   # prune messages can exist even with no delivery
+    assert r.calculate() == (0.0, 3, 1)
+    with pytest.raises(ZeroDivisionError):
+        RelativeMessageRedundancy().calculate()

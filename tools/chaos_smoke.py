@@ -1,0 +1,121 @@
+"""Chaos smoke test: a 1k-node sim under packet loss + churn + partition
+with fixed seeds, asserting graceful degradation and recovery.
+
+Fast CI gate (CPU, well under 60s): runs the jitted engine directly —
+warm-up-free, single origin — through three phases:
+
+  1. baseline      [0, partition_at)           loss + churn only
+  2. partitioned   [partition_at, heal_at)     cross-partition edges suppressed
+  3. healed        [heal_at, iterations)       loss + churn only again
+
+and checks the robustness contract: coverage under partition collapses to
+roughly the origin's side, suppression happens only inside the window,
+churn holds a nonzero failed population that also shrinks (recovery), and
+post-heal coverage regains COVERAGE_RECOVERY_THRESHOLD within
+--recover-within iterations.
+
+Usage: python tools/chaos_smoke.py [--num-nodes 1000] [--seed 7]
+       [--packet-loss 0.1] [--churn-fail 0.01] [--churn-recover 0.2]
+       [--partition-at 8] [--heal-at 20] [--iterations 40]
+       [--recover-within 10]
+
+Exit code 0 = all assertions hold; 1 = a chaos invariant failed.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="1k-node loss+churn+partition smoke (CPU, <60s)")
+    ap.add_argument("--num-nodes", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--packet-loss", type=float, default=0.1)
+    ap.add_argument("--churn-fail", type=float, default=0.01)
+    ap.add_argument("--churn-recover", type=float, default=0.2)
+    ap.add_argument("--partition-at", type=int, default=8)
+    ap.add_argument("--heal-at", type=int, default=20)
+    ap.add_argument("--iterations", type=int, default=40)
+    ap.add_argument("--recover-within", type=int, default=10,
+                    help="iterations after heal by which coverage must "
+                         "regain the recovery threshold")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossip_sim_tpu.constants import COVERAGE_RECOVERY_THRESHOLD
+    from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                       make_cluster_tables, run_rounds)
+
+    t0 = time.time()
+    n = args.num_nodes
+    rng = np.random.default_rng(args.seed)
+    stakes = rng.choice(np.arange(1, 50 * n), size=n,
+                        replace=False).astype(np.int64) * 10**9
+    tables = make_cluster_tables(stakes)
+    params = EngineParams(
+        num_nodes=n, warm_up_rounds=0,
+        packet_loss_rate=args.packet_loss,
+        churn_fail_rate=args.churn_fail,
+        churn_recover_rate=args.churn_recover,
+        partition_at=args.partition_at, heal_at=args.heal_at,
+        impair_seed=args.seed).validate()
+    origins = jnp.arange(1, dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(args.seed), tables, origins, params)
+    state, rows = run_rounds(params, tables, origins, state, args.iterations)
+
+    cov = np.asarray(rows["coverage"])[:, 0]
+    sup = np.asarray(rows["suppressed"])[:, 0]
+    drop = np.asarray(rows["dropped"])[:, 0]
+    failed = np.asarray(rows["failed_count"])[:, 0]
+    pa, ha = args.partition_at, args.heal_at
+
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    print(f"chaos smoke: n={n} loss={args.packet_loss} "
+          f"churn={args.churn_fail}/{args.churn_recover} "
+          f"partition=[{pa},{ha}) iters={args.iterations}")
+    print(f"  coverage: baseline={cov[:pa].mean():.3f} "
+          f"partitioned={cov[pa:ha].mean():.3f} "
+          f"healed-tail={cov[ha + args.recover_within:].mean():.3f}")
+
+    check(drop.sum() > 0, "packet loss dropped messages")
+    check(sup[pa:ha].sum() > 0, "partition suppressed cross-edges")
+    check(sup[:pa].sum() == 0 and sup[ha:].sum() == 0,
+          "no suppression outside the partition window")
+    check(failed[1:].max() > 0, "churn failed some nodes")
+    check((np.diff(failed.astype(np.int64)) < 0).any(),
+          "churned nodes recovered (failed set shrank)")
+    check(cov[pa:ha].max() < COVERAGE_RECOVERY_THRESHOLD,
+          "partition degraded coverage below the recovery threshold")
+    window = cov[ha:ha + args.recover_within]
+    check(window.size > 0 and
+          (window >= COVERAGE_RECOVERY_THRESHOLD).any(),
+          f"coverage recovered >= {COVERAGE_RECOVERY_THRESHOLD} within "
+          f"{args.recover_within} iterations of heal")
+
+    dt = time.time() - t0
+    print(f"  elapsed: {dt:.1f}s")
+    if failures:
+        print(f"CHAOS SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("CHAOS SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
