@@ -16,6 +16,13 @@ timeout.  The parent probes the backend (with retries), then walks a falling
 shape ladder until a rung completes; if the accelerator never comes up it
 falls back to a small CPU run so a number is always printed.  Diagnostics
 (probe errors, failed rungs, versions) ride along in the JSON.
+
+The worker's init_s/compile_s/elapsed_s come from the shared obs span
+registry (gossip_sim_tpu/obs/) — the same spans ``--run-report`` emits —
+so BENCH trajectory lines and product run reports are directly comparable.
+A slow-waking TPU gets more than one probe window via
+``GOSSIP_BENCH_PROBE_TIMEOUT`` (seconds per attempt, default 150) and
+``GOSSIP_BENCH_PROBE_TRIES`` (attempts, default 3).
 """
 
 import argparse
@@ -25,7 +32,7 @@ import subprocess
 import sys
 import time
 
-PER_CHIP_TARGET = 166_667.0 / 8  # origin-iters/s
+from gossip_sim_tpu.obs import PER_CHIP_TARGET  # noqa: F401 (re-export)
 
 # (num_nodes, origin_batch, iterations, per-rung timeout seconds)
 LADDER = [
@@ -35,8 +42,17 @@ LADDER = [
 ]
 CPU_RUNG = (1_000, 4, 20, 600)
 
-PROBE_TIMEOUT = 150
-PROBE_RETRIES = 3
+
+def _env_number(name, default, cast):
+    try:
+        return cast(os.environ.get(name, ""))
+    except (TypeError, ValueError):
+        return default
+
+
+PROBE_TIMEOUT = max(1.0, _env_number("GOSSIP_BENCH_PROBE_TIMEOUT", 150,
+                                     float))
+PROBE_RETRIES = max(1, _env_number("GOSSIP_BENCH_PROBE_TRIES", 3, int))
 
 
 def synthetic_stakes(n, seed=0):
@@ -65,6 +81,7 @@ def worker(args) -> int:
 
     from gossip_sim_tpu.engine import (EngineParams, init_state,
                                        make_cluster_tables, run_rounds)
+    from gossip_sim_tpu.obs import bench_summary, get_registry
 
     platform = jax.devices()[0].platform
     n, o = args.num_nodes, args.origin_batch
@@ -72,42 +89,31 @@ def worker(args) -> int:
     params = EngineParams(num_nodes=n, warm_up_rounds=0)
     origins = jnp.arange(o, dtype=jnp.int32)
 
-    t0 = time.time()
-    state = init_state(jax.random.PRNGKey(0), tables, origins, params)
-    jax.block_until_ready(state)
-    t_init = time.time() - t0
+    # the shared span names (obs/report.py conventions) make this line
+    # field-for-field comparable with a --run-report from a product run
+    reg = get_registry()
+    reg.reset()
+    with reg.span("engine/init"):
+        state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+        jax.block_until_ready(state)
 
     # compile + protocol warm-up (also brings the prune/rotate paths live)
-    t0 = time.time()
-    state, rows = run_rounds(params, tables, origins, state,
-                             args.warmup_timing)
-    jax.block_until_ready(rows)
-    t_compile = time.time() - t0
+    with reg.span("engine/compile"):
+        state, rows = run_rounds(params, tables, origins, state,
+                                 args.warmup_timing)
+        jax.block_until_ready(rows)
 
-    t0 = time.time()
-    state, rows = run_rounds(params, tables, origins, state, args.iterations,
-                             start_it=args.warmup_timing)
-    jax.block_until_ready(rows)
-    dt = time.time() - t0
+    with reg.span("engine/rounds"):
+        state, rows = run_rounds(params, tables, origins, state,
+                                 args.iterations, start_it=args.warmup_timing)
+        jax.block_until_ready(rows)
+    reg.add("origin_iters", o * args.iterations)
 
-    value = o * args.iterations / dt
-    cov = float(np.asarray(rows["coverage"]).mean())
-    rmr = float(np.asarray(rows["rmr"]).mean())
-    result = {
-        "metric": "origin_iters_per_sec",
-        "value": round(value, 2),
-        "unit": "origin*iters/s",
-        "vs_baseline": round(value / PER_CHIP_TARGET, 4),
-        "platform": platform,
-        "num_nodes": n,
-        "origin_batch": o,
-        "iterations": args.iterations,
-        "elapsed_s": round(dt, 3),
-        "init_s": round(t_init, 3),
-        "compile_s": round(t_compile, 3),
-        "coverage_mean": round(cov, 6),
-        "rmr_mean": round(rmr, 6),
-    }
+    result = bench_summary(
+        reg, platform=platform, num_nodes=n, origin_batch=o,
+        iterations=args.iterations,
+        coverage_mean=float(np.asarray(rows["coverage"]).mean()),
+        rmr_mean=float(np.asarray(rows["rmr"]).mean()))
     print(json.dumps(result))
     return 0
 

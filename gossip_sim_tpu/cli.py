@@ -27,6 +27,7 @@ from .identity import NodeIndex
 from .ingest import (fetch_vote_accounts_rpc, filter_accounts,
                      load_accounts_yaml, log_cluster_summary,
                      synthetic_accounts)
+from .obs import Heartbeat, get_registry
 from .oracle.rustrng import ChaChaRng
 from .sinks import (DatapointQueue, InfluxDataPoint, InfluxThread,
                     load_dotenv)
@@ -69,6 +70,18 @@ def _warn_shape_truncation(rows, params) -> tuple[int, int]:
             "were evicted early — prune decisions may diverge. Raise "
             "EngineParams.rc_slots.", overflow, params.rc_slots)
     return dropped, overflow
+
+
+def _engine_call_span(reg, fallback: str = "engine/rounds"):
+    """The first jitted rounds call of a run carries the trace+compile cost
+    (obs/report.py span conventions), so it records under engine/compile;
+    later calls — warm-cache re-runs in a sweep, steady-state measured
+    blocks — record under ``fallback``.  Returns (context manager,
+    counts_toward_throughput): only engine/rounds time may feed the
+    origin-iters / messages throughput denominators."""
+    name = ("engine/compile" if reg.count("engine/compile") == 0
+            else fallback)
+    return reg.span(name), name == "engine/rounds"
 
 
 def _impair_params(config) -> dict:
@@ -170,11 +183,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-devices", type=int, default=0,
                    help="devices to shard origin batches over in "
                         "--all-origins mode (0 = all available)")
-    p.add_argument("--jax-profile", dest="jax_profile_dir", default="",
-                   metavar="DIR",
+    p.add_argument("--profile-dir", "--jax-profile", dest="jax_profile_dir",
+                   default="", metavar="DIR",
                    help="tpu backend: capture a jax.profiler trace of the "
                         "measured rounds into DIR (view with TensorBoard "
-                        "or xprof)")
+                        "or xprof; the round/* named scopes label the "
+                        "protocol verbs)")
+    p.add_argument("--run-report", dest="run_report_path", default="",
+                   metavar="PATH",
+                   help="write a machine-readable run report JSON to PATH: "
+                        "config, environment, span timings, throughput, "
+                        "fault + influx counters (schema shared with "
+                        "bench.py; see obs/report.py)")
     p.add_argument("--checkpoint-path", default="",
                    help="save the simulation state (SimState arrays + "
                         "params) to this .npz after each measured block and "
@@ -235,6 +255,7 @@ def config_from_args(args) -> Config:
         resume_path=args.resume_path,
         mesh_devices=args.mesh_devices,
         jax_profile_dir=args.jax_profile_dir,
+        run_report_path=args.run_report_path,
     )
 
 
@@ -265,24 +286,29 @@ def find_nth_largest_node(n, items):
 def load_cluster_accounts(config: Config, json_rpc_url: str):
     """Resolve the account source (gossip_main.rs:302-328) -> ({pk: stake},
     source label)."""
-    if config.num_synthetic_nodes > 0:
-        rng = ChaChaRng.from_seed_byte(config.seed % 256)
-        accounts = synthetic_accounts(config.num_synthetic_nodes, rng)
-        label = f"synthetic:{config.num_synthetic_nodes}"
-    elif config.accounts_from_file:
-        if not config.account_file:
-            log.error("need --account-file <path> with --accounts-from-yaml")
-            raise SystemExit(-1)
-        log.info("Reading %s", config.account_file)
-        accounts = load_accounts_yaml(config.account_file)
-        label = config.account_file
-    else:
-        url = get_json_rpc_url(json_rpc_url)
-        log.info("json_rpc_url: %s", url)
-        accounts = fetch_vote_accounts_rpc(url)
-        label = url
-    accounts = filter_accounts(accounts, config.filter_zero_staked_nodes)
-    log_cluster_summary(accounts)
+    reg = get_registry()
+    with reg.span("ingest"):
+        if config.num_synthetic_nodes > 0:
+            rng = ChaChaRng.from_seed_byte(config.seed % 256)
+            accounts = synthetic_accounts(config.num_synthetic_nodes, rng)
+            label = f"synthetic:{config.num_synthetic_nodes}"
+        elif config.accounts_from_file:
+            if not config.account_file:
+                log.error("need --account-file <path> with "
+                          "--accounts-from-yaml")
+                raise SystemExit(-1)
+            log.info("Reading %s", config.account_file)
+            accounts = load_accounts_yaml(config.account_file)
+            label = config.account_file
+        else:
+            url = get_json_rpc_url(json_rpc_url)
+            log.info("json_rpc_url: %s", url)
+            accounts = fetch_vote_accounts_rpc(url)
+            label = url
+        accounts = filter_accounts(accounts, config.filter_zero_staked_nodes)
+        log_cluster_summary(accounts)
+    reg.set_info("num_nodes", len(accounts))
+    reg.set_info("account_source", label)
     return accounts, label
 
 
@@ -300,13 +326,16 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
         log.warning("WARNING: --checkpoint-path is supported by the tpu "
                     "backend only; the oracle backend will not write %s",
                     config.checkpoint_path)
+    reg = get_registry()
+    reg.set_info("platform", "oracle")
     rng = ChaChaRng.from_seed_byte(config.seed % 256)
     stakes = dict(accounts)
     nodes = [Node(pk, stake) for pk, stake in accounts.items()]
     node_map = {nd.pubkey: nd for nd in nodes}
     log.info("Simulating Gossip and setting active sets. Please wait.....")
-    for node in nodes:
-        node.initialize_gossip(rng, stakes, config.gossip_active_set_size)
+    with reg.span("engine/init"):
+        for node in nodes:
+            node.initialize_gossip(rng, stakes, config.gossip_active_set_size)
     log.info("Simulation Complete!")
 
     impair = None
@@ -322,9 +351,13 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
             partition_at=config.partition_at, heal_at=config.heal_at)
 
     cluster = Cluster(config.gossip_push_fanout)
+    hb = Heartbeat(config.gossip_iterations, label="oracle rounds",
+                   unit="iter")
     for it in range(config.gossip_iterations):
+        t_it = time.perf_counter()
         if it % 10 == 0:
             log.info("GOSSIP ITERATION: %s", it)
+            hb.beat(it)
             _push_config_point(config, dp_queue, sim_iter, start_ts)
         if config.test_type == Testing.FAIL_NODES and it == config.when_to_fail:
             cluster.fail_nodes(config.fraction_to_fail, nodes, rng)
@@ -348,6 +381,12 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
             cluster.print_prunes()
         cluster.chance_to_rotate(rng, nodes, config.gossip_active_set_size,
                                  stakes, config.probability_of_rotation)
+        if it >= config.warm_up_rounds:
+            # measured simulation compute only — warm-up rounds and the
+            # stats harvest below stay out, mirroring the TPU path's
+            # engine/rounds vs stats/harvest split
+            reg.record("engine/rounds", time.perf_counter() - t_it)
+            reg.add("origin_iters", 1)
         if it + 1 == config.warm_up_rounds:
             cluster.clear_message_counts()
         post_heal = config.heal_at >= 0 and it >= config.heal_at
@@ -357,6 +396,7 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
             # recovery metric sees every post-heal round, warm-up included
             stats.note_post_heal_coverage(it, coverage)
         if it >= config.warm_up_rounds:
+            t_h = time.perf_counter()
             steady = it - config.warm_up_rounds
             if coverage < POOR_COVERAGE_THRESHOLD:
                 log.warning("WARNING: poor coverage for origin: %s, %s",
@@ -376,6 +416,8 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
                                       len(cluster.failed_nodes))
             _push_iteration_points(config, dp_queue, sim_iter, start_ts,
                                    stats, steady, coverage, rmr_result)
+            reg.record("stats/harvest", time.perf_counter() - t_h)
+            reg.add("messages_delivered", rmr_result[1])
     if impair is not None and impair.has_churn:
         stats.set_failed_nodes(cluster.failed_nodes)
     return stakes
@@ -391,6 +433,7 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
     from .engine import (EngineParams, init_state, make_cluster_tables,
                          run_rounds)
 
+    reg = get_registry()
     index = NodeIndex.from_stakes(accounts)
     stakes = dict(accounts)
     N = len(index)
@@ -408,14 +451,19 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
                        if config.test_type == Testing.FAIL_NODES else 0.0),
         **_impair_params(config),
     )
-    tables = make_cluster_tables(index.stakes.astype(np.int64))
+    with reg.span("engine/tables"):
+        tables = make_cluster_tables(index.stakes.astype(np.int64))
+    reg.set_info("platform", jax.devices()[0].platform)
+    reg.set_info("origin_batch", 1)
     origin_idx = index.index_of(origin_pubkey)
     origins = jnp.asarray([origin_idx], dtype=jnp.int32)
 
     start_iter = 0
     if config.resume_path:
         from .checkpoint import restore_sim_state
-        state, _, meta = restore_sim_state(config.resume_path, params, tables)
+        with reg.span("checkpoint/restore"):
+            state, _, meta = restore_sim_state(config.resume_path, params,
+                                               tables)
         start_iter = int(meta.get("iteration", 0))
         saved_cfg = meta.get("config", {})
         # any field that changes round dynamics breaks the bit-exact-
@@ -444,8 +492,10 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
             return stakes
     else:
         log.info("Simulating Gossip and setting active sets. Please wait.....")
-        state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
-                           params)
+        with reg.span("engine/init"):
+            state = init_state(jax.random.PRNGKey(config.seed), tables,
+                               origins, params)
+            jax.block_until_ready(state)
         log.info("Simulation Complete!")
 
     def _record_failed():
@@ -455,8 +505,9 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
     def _save_checkpoint(iteration):
         if config.checkpoint_path:
             from .checkpoint import save_state
-            save_state(config.checkpoint_path, state, params, config,
-                       iteration=iteration)
+            with reg.span("checkpoint/save"):
+                save_state(config.checkpoint_path, state, params, config,
+                           iteration=iteration)
 
     if config.resume_path and 0 <= params.fail_at < start_iter:
         _record_failed()
@@ -468,8 +519,13 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
         for it in range(start_iter, warm, 10):
             log.info("GOSSIP ITERATION: %s", it)
             _push_config_point(config, dp_queue, sim_iter, start_ts)
-        state, wrows = run_rounds(params, tables, origins, state,
-                                  warm - start_iter, start_it=start_iter)
+        # the run's first jitted call carries the compile; later sims in a
+        # sweep hit the jit cache and record as plain warm-up compute
+        cm, _ = _engine_call_span(reg, fallback="engine/warmup")
+        with cm:
+            state, wrows = run_rounds(params, tables, origins, state,
+                                      warm - start_iter, start_it=start_iter)
+            jax.block_until_ready(wrows)
         if config.heal_at >= 0 and config.heal_at < warm:
             # post-heal coverage inside the warm-up scan still feeds the
             # recovery metric (iteration-exact, like the oracle loop and
@@ -492,26 +548,43 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
                   if config.jax_profile_dir else contextlib.nullcontext())
     block = 256
     done = max(0, start_iter - warm)
+    hb = Heartbeat(measured, label=f"sim {sim_iter} measured rounds",
+                   unit="iter")
     with profile_cm:
         while done < measured:
             n_it = min(block, measured - done)
             start_it = warm + done
-            state, rows = run_rounds(params, tables, origins, state, n_it,
-                                     start_it=start_it, detail=True)
-            rows = jax.tree_util.tree_map(np.asarray, rows)
-            _warn_shape_truncation(rows, params)
-            if (params.fail_at >= 0
-                    and start_it <= params.fail_at < start_it + n_it):
-                _record_failed()
-            for t in range(n_it):
-                it = start_it + t
-                if it % 10 == 0:
-                    log.info("GOSSIP ITERATION: %s", it)
-                    _push_config_point(config, dp_queue, sim_iter, start_ts)
-                _feed_measured_round(stats, rows, t, 0, it, config, index,
-                                     stakes, origin_pubkey, dp_queue,
-                                     sim_iter, start_ts)
+            t_blk = time.perf_counter()
+            # without a warm-up scan (warm-up 0 / resume past warm-up) the
+            # first measured block carries the compile: keep it out of the
+            # steady-state rounds span and throughput denominators
+            cm, counted = _engine_call_span(reg)
+            with cm:
+                state, rows = run_rounds(params, tables, origins, state, n_it,
+                                         start_it=start_it, detail=True)
+                rows = jax.tree_util.tree_map(np.asarray, rows)
+            blk_wall = time.perf_counter() - t_blk
+            if counted:
+                reg.add("origin_iters", n_it)
+                reg.add("messages_delivered", int(rows["delivered"].sum()))
+            with reg.span("stats/harvest"):
+                _warn_shape_truncation(rows, params)
+                if (params.fail_at >= 0
+                        and start_it <= params.fail_at < start_it + n_it):
+                    _record_failed()
+                for t in range(n_it):
+                    it = start_it + t
+                    if it % 10 == 0:
+                        log.info("GOSSIP ITERATION: %s", it)
+                        _push_config_point(config, dp_queue, sim_iter,
+                                           start_ts)
+                    _feed_measured_round(stats, rows, t, 0, it, config, index,
+                                         stakes, origin_pubkey, dp_queue,
+                                         sim_iter, start_ts)
             done += n_it
+            hb.beat(done)
+            _push_sim_perf_point(dp_queue, sim_iter, start_ts, blk_wall,
+                                 n_it, 1)
             _save_checkpoint(warm + done)
     if config.jax_profile_dir:
         log.info("jax.profiler trace written to %s", config.jax_profile_dir)
@@ -621,7 +694,11 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         warm_up_rounds=config.warm_up_rounds,
         **_impair_params(config),
     )
-    tables = make_cluster_tables(index.stakes.astype(np.int64))
+    reg = get_registry()
+    with reg.span("engine/tables"):
+        tables = make_cluster_tables(index.stakes.astype(np.int64))
+    reg.set_info("platform", jax.devices()[0].platform)
+    reg.set_info("origin_batch", R)
 
     stats_list = []
     for i, c in enumerate(configs):
@@ -648,15 +725,20 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         dp_queue.push_back(dp)
 
     log.info("Simulating Gossip and setting active sets. Please wait.....")
-    state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
-                       params)
+    with reg.span("engine/init"):
+        state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
+                           params)
+        jax.block_until_ready(state)
     log.info("Simulation Complete!")
 
     warm = min(config.warm_up_rounds, config.gossip_iterations)
     if warm > 0:
         for it in range(0, warm, 10):
             log.info("GOSSIP ITERATION: %s", it)
-        state, wrows = run_rounds(params, tables, origins, state, warm)
+        cm, _ = _engine_call_span(reg, fallback="engine/warmup")
+        with cm:
+            state, wrows = run_rounds(params, tables, origins, state, warm)
+            jax.block_until_ready(wrows)
         if config.heal_at >= 0 and config.heal_at < warm:
             # heal inside warm-up: the recovery metric still needs every
             # post-heal round (iteration-exact, like the other run paths)
@@ -668,24 +750,38 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
     measured = config.gossip_iterations - warm
     block = 256
     done = 0
+    hb = Heartbeat(measured, label="origin-rank sweep measured rounds",
+                   unit="iter")
     while done < measured:
         n_it = min(block, measured - done)
         start_it = warm + done
-        state, rows = run_rounds(params, tables, origins, state, n_it,
-                                 start_it=start_it, detail=True)
-        rows = jax.tree_util.tree_map(np.asarray, rows)
-        _warn_shape_truncation(rows, params)
-        for t in range(n_it):
-            it = start_it + t
-            if it % 10 == 0:
-                log.info("GOSSIP ITERATION: %s", it)
-            for col in range(R):
+        t_blk = time.perf_counter()
+        cm, counted = _engine_call_span(reg)
+        with cm:
+            state, rows = run_rounds(params, tables, origins, state, n_it,
+                                     start_it=start_it, detail=True)
+            rows = jax.tree_util.tree_map(np.asarray, rows)
+        blk_wall = time.perf_counter() - t_blk
+        if counted:
+            reg.add("origin_iters", R * n_it)
+            reg.add("messages_delivered", int(rows["delivered"].sum()))
+        with reg.span("stats/harvest"):
+            _warn_shape_truncation(rows, params)
+            for t in range(n_it):
+                it = start_it + t
                 if it % 10 == 0:
-                    _push_config_point(configs[col], dp_queue, col, start_ts)
-                _feed_measured_round(stats_list[col], rows, t, col, it,
-                                     configs[col], index, stakes,
-                                     origin_pks[col], dp_queue, col, start_ts)
+                    log.info("GOSSIP ITERATION: %s", it)
+                for col in range(R):
+                    if it % 10 == 0:
+                        _push_config_point(configs[col], dp_queue, col,
+                                           start_ts)
+                    _feed_measured_round(stats_list[col], rows, t, col, it,
+                                         configs[col], index, stakes,
+                                         origin_pks[col], dp_queue, col,
+                                         start_ts)
         done += n_it
+        hb.beat(done)
+        _push_sim_perf_point(dp_queue, 0, start_ts, blk_wall, n_it, R)
 
     for col in range(R):
         _feed_message_counters(stats_list[col], state, col, index)
@@ -716,8 +812,10 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
 
     if accounts is None:
         accounts, _ = load_cluster_accounts(config, json_rpc_url)
+    reg = get_registry()
     index = NodeIndex.from_stakes(accounts)
     N = len(index)
+    reg.set_info("num_nodes", N)
     params = EngineParams(
         num_nodes=N,
         push_fanout=config.gossip_push_fanout,
@@ -728,11 +826,13 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         warm_up_rounds=config.warm_up_rounds,
         **_impair_params(config),
     )
-    tables = make_cluster_tables(index.stakes.astype(np.int64))
+    with reg.span("engine/tables"):
+        tables = make_cluster_tables(index.stakes.astype(np.int64))
 
     # ---- device mesh (parallel/mesh.py): origins axis is collective-free
     mesh = None
     n_dev = len(jax.devices())
+    reg.set_info("platform", jax.devices()[0].platform)
     mesh_dev = config.mesh_devices or n_dev
     if mesh_dev > n_dev:
         log.warning("WARNING: --mesh-devices %s > %s visible device(s); "
@@ -750,8 +850,11 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
     batch = config.origin_batch or max(1, min(64, (1 << 22) // max(N, 1)))
     if mesh is not None:
         batch = max(mesh_dev, batch // mesh_dev * mesh_dev)
+    reg.set_info("origin_batch", batch)
+    reg.set_info("mesh_shape", [mesh_dev] if mesh is not None else [1])
 
     agg = AllOriginsStats(index, params.hist_bins)
+    hb = Heartbeat(total_o, label="all-origins", unit="origin")
     t0 = time.time()
     for lo in range(0, total_o, batch):
         chunk = all_origins[lo:lo + batch]
@@ -762,24 +865,45 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
             pad = mesh_dev - n_valid % mesh_dev
             chunk = np.concatenate([chunk, np.zeros(pad, np.int32)])
         origins = jnp.asarray(chunk, dtype=jnp.int32)
-        state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
-                           params)
+        with reg.span("engine/init"):
+            state = init_state(jax.random.PRNGKey(config.seed), tables,
+                               origins, params)
+            jax.block_until_ready(state)
         if mesh is not None:
             from .parallel import shard_sim
             state, origins = shard_sim(mesh, state, origins,
                                        shard_nodes=False)
-        state, rows = run_rounds(params, tables, origins, state,
-                                 config.gossip_iterations)
-        rows = jax.tree_util.tree_map(
-            lambda a: np.asarray(a)[..., :n_valid], rows)
-        state_np = jax.tree_util.tree_map(np.asarray, state)
-        state_np = type(state_np)(**{
-            f: getattr(state_np, f)[:n_valid] for f in state_np._fields})
-        agg.add_batch(rows, state_np, config.warm_up_rounds,
-                      heal_at=config.heal_at,
-                      impaired=config.impairments_on)
+        # the first batch's scan call carries the compile (per obs/report.py
+        # span conventions); later batches of the same width hit the cache.
+        # A single-batch run has no steady-state batch to time, so it
+        # records under engine/rounds with the compile embedded (the same
+        # caveat a freshly-compiled bench elapsed_s has) rather than
+        # reporting zero throughput.
+        single_batch = total_o <= batch
+        span_name = ("engine/rounds" if lo > 0 or single_batch
+                     else "engine/compile")
+        t_blk = time.perf_counter()
+        with reg.span(span_name):
+            state, rows = run_rounds(params, tables, origins, state,
+                                     config.gossip_iterations)
+            rows = jax.tree_util.tree_map(
+                lambda a: np.asarray(a)[..., :n_valid], rows)
+        blk_wall = time.perf_counter() - t_blk
+        if span_name == "engine/rounds":
+            reg.add("origin_iters", n_valid * config.gossip_iterations)
+            reg.add("messages_delivered", int(rows["delivered"].sum()))
+        with reg.span("stats/harvest"):
+            state_np = jax.tree_util.tree_map(np.asarray, state)
+            state_np = type(state_np)(**{
+                f: getattr(state_np, f)[:n_valid] for f in state_np._fields})
+            agg.add_batch(rows, state_np, config.warm_up_rounds,
+                          heal_at=config.heal_at,
+                          impaired=config.impairments_on)
+        _push_sim_perf_point(dp_queue, 0, start_ts, blk_wall,
+                             config.gossip_iterations, n_valid)
         log.info("all-origins: %s/%s origins done",
                  min(lo + n_valid, total_o), total_o)
+        hb.beat(min(lo + n_valid, total_o))
     dt = time.time() - t0
 
     if agg.measured_points == 0:
@@ -821,6 +945,20 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
 # --------------------------------------------------------------------------
 # influx helpers
 # --------------------------------------------------------------------------
+
+def _push_sim_perf_point(dp_queue, sim_iter, start_ts, block_wall_s, n_iters,
+                         n_origins):
+    """Runtime-telemetry series (obs/): one point per measured round block
+    with its wall time, throughput, and the sender queue depth — the live
+    "is the sim keeping up / is the sink backed up" signal."""
+    if dp_queue is None:
+        return
+    thr = n_origins * n_iters / block_wall_s if block_wall_s > 0 else 0.0
+    dp = InfluxDataPoint(start_ts, sim_iter)
+    dp.create_sim_perf_point(round(block_wall_s, 6), round(thr, 2),
+                             len(dp_queue), n_iters)
+    dp_queue.push_back(dp)
+
 
 def _push_config_point(config, dp_queue, sim_iter, start_ts):
     if dp_queue is None:
@@ -979,6 +1117,76 @@ def _finalize_sim_stats(config, stats, stakes, stats_collection, dp_queue,
 
 
 # --------------------------------------------------------------------------
+# run-report + influx-drain helpers (obs/)
+# --------------------------------------------------------------------------
+
+def _drain_influx(dp_queue, influx_thread):
+    """Push the end sentinel, drain the reporter thread, and surface the
+    sender's delivery accounting (points sent / dropped / retries) at
+    end-of-run instead of only inside the drain log."""
+    if dp_queue is None:
+        return None
+    dp = InfluxDataPoint()
+    dp.set_last_datapoint()
+    dp_queue.push_back(dp)
+    if influx_thread is None:
+        return None
+    with get_registry().span("influx/drain"):
+        influx_thread.join()
+    sender = influx_thread.sender_stats()
+    sender["queue_depth_at_exit"] = len(dp_queue)
+    log.info("influx sender: %s point(s) sent, %s dropped, %s "
+             "transient-failure retr%s", sender["points_sent"],
+             sender["dropped_points"], sender["retries"],
+             "y" if sender["retries"] == 1 else "ies")
+    return sender
+
+
+def _collection_summaries(collection):
+    """(stats, faults) run-report sections from a finished sweep
+    collection; (None, None) when nothing was measured."""
+    sims = [s for s in collection.collection if not s.is_empty()]
+    if not sims:
+        return None, None
+    stats = {
+        "num_simulations": len(sims),
+        "coverage_mean": float(np.mean([s.coverage_stats.mean
+                                        for s in sims])),
+        "rmr_mean": float(np.mean([s.rmr_stats.mean for s in sims])),
+    }
+    delivery = [s for s in sims if s.has_delivery_stats()]
+    faults = None
+    if delivery:
+        faults = {
+            "delivered": int(sum(sum(s.delivered_stats.collection)
+                                 for s in delivery)),
+            "dropped": int(sum(sum(s.dropped_stats.collection)
+                               for s in delivery)),
+            "suppressed": int(sum(sum(s.suppressed_stats.collection)
+                                  for s in delivery)),
+            "failed_final": int(max((s.failed_count_series[-1]
+                                     for s in delivery
+                                     if s.failed_count_series), default=0)),
+        }
+    return stats, faults
+
+
+def _write_run_report(config, stats=None, faults=None, influx=None):
+    if not config.run_report_path:
+        return
+    from .obs.report import (build_run_report, validate_run_report,
+                             write_run_report)
+    report = build_run_report(config, get_registry(), stats=stats,
+                              influx=influx, faults=faults)
+    problems = validate_run_report(report)
+    if problems:  # self-check: a malformed report is a bug, not a crash
+        log.warning("WARNING: run report failed schema self-check: %s",
+                    problems)
+    write_run_report(config.run_report_path, report)
+    log.info("run report written to %s", config.run_report_path)
+
+
+# --------------------------------------------------------------------------
 # sweep dispatch (gossip_main.rs:774-951)
 # --------------------------------------------------------------------------
 
@@ -993,6 +1201,7 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
         run_origin_rank_sweep(config, json_rpc_url, origin_ranks,
                               collection, dp_queue, start_ts)
         return
+    hb = Heartbeat(config.num_simulations, label="sweep", unit="simulation")
     for i in range(config.num_simulations):
         if tt == Testing.ACTIVE_SET_SIZE:
             v = config.gossip_active_set_size + i * config.step_size.as_int()
@@ -1041,6 +1250,9 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
             c, start = config, 0.0
         run_simulation(c, json_rpc_url, collection, dp_queue, i, start_ts,
                        start)
+        hb.beat(i + 1)
+    if config.num_simulations > 1:
+        hb.finish()
 
 
 def main(argv=None) -> int:
@@ -1049,6 +1261,9 @@ def main(argv=None) -> int:
         format="[%(asctime)s %(levelname)s %(name)s] %(message)s")
     args = build_parser().parse_args(argv)
     config = config_from_args(args)
+    # one process == one run: start the telemetry registry clean so spans,
+    # counters and the run report cover exactly this invocation
+    get_registry().reset()
     origin_ranks = args.origin_rank
     if any(r < 1 for r in origin_ranks):
         log.error("ERROR: --origin-rank values must be >= 1 (1 = highest "
@@ -1107,13 +1322,28 @@ def main(argv=None) -> int:
             log.info("all-origins: emitting run-level aggregate Influx "
                      "series (per-iteration series are a single-origin "
                      "feature)")
-        run_all_origins(config, args.json_rpc_url, dp_queue, start_ts)
-        if dp_queue is not None:
-            dp = InfluxDataPoint()
-            dp.set_last_datapoint()
-            dp_queue.push_back(dp)
-            if influx_thread is not None:
-                influx_thread.join()
+        summary = run_all_origins(config, args.json_rpc_url, dp_queue,
+                                  start_ts)
+        influx_stats = _drain_influx(dp_queue, influx_thread)
+        stats = {
+            "coverage_mean": summary["coverage_mean"],
+            "rmr_mean": summary["rmr_mean"],
+            "num_origins": summary["num_origins"],
+            "measured_points": summary["measured_points"],
+            "end_to_end_origin_iters_per_sec":
+                summary["origin_iters_per_sec"],
+            "end_to_end_elapsed_s": summary["elapsed_s"],
+        }
+        faults = None
+        agg = summary.get("stats")
+        if config.impairments_on and agg is not None:
+            faults = {
+                "delivered": int(sum(agg.delivered_stats.collection)),
+                "dropped": int(agg.total_dropped),
+                "suppressed": int(agg.total_suppressed),
+            }
+        _write_run_report(config, stats=stats, faults=faults,
+                          influx=influx_stats)
         return 0
 
     collection = GossipStatsCollection()
@@ -1121,12 +1351,10 @@ def main(argv=None) -> int:
     dispatch_sweeps(config, args.json_rpc_url, origin_ranks, collection,
                     dp_queue, start_ts)
 
-    if dp_queue is not None:
-        dp = InfluxDataPoint()
-        dp.set_last_datapoint()
-        dp_queue.push_back(dp)
-        if influx_thread is not None:
-            influx_thread.join()
+    influx_stats = _drain_influx(dp_queue, influx_thread)
+    stats, faults = _collection_summaries(collection)
+    _write_run_report(config, stats=stats, faults=faults,
+                      influx=influx_stats)
 
     if config.print_stats:
         if not collection.is_empty():

@@ -115,7 +115,10 @@ class Config:
     resume_path: str = ""           # load sim state and continue
     mesh_devices: int = 0           # 0 = all available devices
     jax_profile_dir: str = ""       # capture jax.profiler trace of measured
-                                    # rounds (tpu backend)
+                                    # rounds (tpu backend); XProf shows the
+                                    # round/* named_scope stages (obs/)
+    run_report_path: str = ""       # write the machine-readable run report
+                                    # (obs/report.py schema) to this path
 
     def stepped(self, **kw) -> "Config":
         return replace(self, **kw)

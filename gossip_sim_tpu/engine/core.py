@@ -53,6 +53,11 @@ Documented divergences from the reference are unchanged from v1 (see
 git history of this module): distributional sampling parity, exact prune
 bits instead of 0.1-fp blooms, ``inbound_cap`` ranking, ``rc_slots``
 physical slots, index tie-breaks, counter-based RNG streams.
+
+Every stage of ``round_step`` is wrapped in a ``jax.named_scope`` (the
+``round/*`` scopes), so an XProf/TensorBoard trace captured with
+``--profile-dir`` (obs/) attributes device time to the protocol verbs.
+Scopes are compile-time metadata: the emitted HLO is unchanged.
 """
 
 from __future__ import annotations
@@ -342,456 +347,466 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     nsub = p.rot_tries + 2
     subs = jax.vmap(lambda k: jax.random.split(k, nsub))(kr)     # [O, nsub, 2]
 
-    # ---- fault injection (gossip.rs:756-771; fires when it == when_to_fail,
-    # gossip_main.rs:449-452) --------------------------------------------
-    failed, tfail = state.failed, state.tfail
-    # truncating, like the reference's `as usize` (gossip.rs:758)
-    n_fail = int(p.fail_fraction * N)
-    if p.fail_at >= 0 and n_fail > 0:
-        def _fail(ft):
-            f, _ = ft
-            r = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
-                subs[:, 0])
-            kth = jnp.sort(r, axis=-1)[:, n_fail - 1][:, None]
-            f = f | (r <= kth)
-            # rebuild per-slot target-failed bits via sort-join (once)
+    with jax.named_scope("round/fault_inject"):
+        # ---- fault injection (gossip.rs:756-771; fires when it == when_to_fail,
+        # gossip_main.rs:449-452) --------------------------------------------
+        failed, tfail = state.failed, state.tfail
+        # truncating, like the reference's `as usize` (gossip.rs:758)
+        n_fail = int(p.fail_fraction * N)
+        if p.fail_at >= 0 and n_fail > 0:
+            def _fail(ft):
+                f, _ = ft
+                r = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
+                    subs[:, 0])
+                kth = jnp.sort(r, axis=-1)[:, n_fail - 1][:, None]
+                f = f | (r <= kth)
+                # rebuild per-slot target-failed bits via sort-join (once)
+                q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
+                tf = _lookup(f.astype(jnp.int32), q, N,
+                             pack).reshape(O, N, S) == 1
+                return f, tf & (state.active < N)
+            failed, tfail = lax.cond(it == p.fail_at, _fail,
+                                     lambda ft: ft, (failed, tfail))
+
+    with jax.named_scope("round/churn"):
+        # ---- continuous churn (faults.py): one hash per (iteration, node),
+        # interpreted against the node's current state; recovered nodes rejoin
+        # delivery immediately (their tfail bits clear this round) -------------
+        if p.has_churn:
+            basis_c = round_basis_arr(p.impair_seed, it, SALT_CHURN, jnp)
+            hu64 = node_u32_arr(basis_c, jnp.arange(N, dtype=jnp.uint32),
+                                jnp).astype(jnp.uint64)
+            fail_ev = hu64 < rate_threshold(p.churn_fail_rate)       # [N]
+            rec_ev = hu64 < rate_threshold(p.churn_recover_rate)     # [N]
+            failed = jnp.where(failed, ~rec_ev[None, :], fail_ev[None, :])
             q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
-            tf = _lookup(f.astype(jnp.int32), q, N,
-                         pack).reshape(O, N, S) == 1
-            return f, tf & (state.active < N)
-        failed, tfail = lax.cond(it == p.fail_at, _fail,
-                                 lambda ft: ft, (failed, tfail))
+            tfail = (_lookup(failed.astype(jnp.int32), q, N,
+                             pack).reshape(O, N, S) == 1) & (state.active < N)
 
-    # ---- continuous churn (faults.py): one hash per (iteration, node),
-    # interpreted against the node's current state; recovered nodes rejoin
-    # delivery immediately (their tfail bits clear this round) -------------
-    if p.has_churn:
-        basis_c = round_basis_arr(p.impair_seed, it, SALT_CHURN, jnp)
-        hu64 = node_u32_arr(basis_c, jnp.arange(N, dtype=jnp.uint32),
-                            jnp).astype(jnp.uint64)
-        fail_ev = hu64 < rate_threshold(p.churn_fail_rate)       # [N]
-        rec_ev = hu64 < rate_threshold(p.churn_recover_rate)     # [N]
-        failed = jnp.where(failed, ~rec_ev[None, :], fail_ev[None, :])
-        q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
-        tfail = (_lookup(failed.astype(jnp.int32), q, N,
-                         pack).reshape(O, N, S) == 1) & (state.active < N)
+    with jax.named_scope("round/verb1_push_targets"):
+        # ---- verb 1: push targets (gossip.rs:494-615) -----------------------
+        peer = state.active
+        is_peer = peer < N
+        # get_nodes filter: bloom-contains(origin) == pruned bit OR peer == origin
+        # (self-seeded bloom, push_active_set.rs:128-141,179).
+        valid = is_peer & (~state.pruned) & (peer != origin_col)
+        # first F valid slots, failed targets consume a slot but receive nothing
+        # (gossip.rs:538-541): compact (slot-order) then mask failed targets.
+        skey = jnp.where(valid, jnp.arange(S, dtype=jnp.int32)[None, None, :], S)
+        skey_s, peer_sf, tfail_sf = lax.sort(
+            (skey, peer, tfail.astype(jnp.int32)), dimension=-1, num_keys=1)
+        slot_ok = skey_s[..., :F] < S
+        peerF = peer_sf[..., :F]
+        # live candidate pushes; partition suppression and packet loss consume
+        # the slot exactly like failed targets do (precedence: failed target >
+        # partition > loss — matching the oracle's classify_edge)
+        deliver_ok = slot_ok & (tfail_sf[..., :F] == 0)              # [O,N,F]
+        sup_mask = drop_mask = None
+        if p.partition_at >= 0:
+            part_on = it >= p.partition_at
+            if p.heal_at >= 0:
+                part_on = part_on & (it < p.heal_at)
+            side_dst = tables.side[jnp.minimum(peerF, N)]            # [O,N,F]
+            sup_mask = (deliver_ok & part_on
+                        & (tables.side[:N][None, :, None] != side_dst))
+            deliver_ok = deliver_ok & ~sup_mask
+        if p.packet_loss_rate > 0.0:
+            basis_e = round_basis_arr(p.impair_seed, it, SALT_EDGE, jnp)
+            ue = edge_u32_arr(basis_e, iota_n.astype(jnp.uint32)[:, :, None],
+                              peerF.astype(jnp.uint32), jnp)
+            drop_mask = deliver_ok & (ue.astype(jnp.uint64)
+                                      < rate_threshold(p.packet_loss_rate))
+            deliver_ok = deliver_ok & ~drop_mask
+        tgt = jnp.where(deliver_ok, peerF, N)                        # [O,N,F]
+        tgtf = tgt.reshape(O, NF)
+        pseudo_t = jnp.broadcast_to(iota_n, (O, N))
 
-    # ---- verb 1: push targets (gossip.rs:494-615) -----------------------
-    peer = state.active
-    is_peer = peer < N
-    # get_nodes filter: bloom-contains(origin) == pruned bit OR peer == origin
-    # (self-seeded bloom, push_active_set.rs:128-141,179).
-    valid = is_peer & (~state.pruned) & (peer != origin_col)
-    # first F valid slots, failed targets consume a slot but receive nothing
-    # (gossip.rs:538-541): compact (slot-order) then mask failed targets.
-    skey = jnp.where(valid, jnp.arange(S, dtype=jnp.int32)[None, None, :], S)
-    skey_s, peer_sf, tfail_sf = lax.sort(
-        (skey, peer, tfail.astype(jnp.int32)), dimension=-1, num_keys=1)
-    slot_ok = skey_s[..., :F] < S
-    peerF = peer_sf[..., :F]
-    # live candidate pushes; partition suppression and packet loss consume
-    # the slot exactly like failed targets do (precedence: failed target >
-    # partition > loss — matching the oracle's classify_edge)
-    deliver_ok = slot_ok & (tfail_sf[..., :F] == 0)              # [O,N,F]
-    sup_mask = drop_mask = None
-    if p.partition_at >= 0:
-        part_on = it >= p.partition_at
-        if p.heal_at >= 0:
-            part_on = part_on & (it < p.heal_at)
-        side_dst = tables.side[jnp.minimum(peerF, N)]            # [O,N,F]
-        sup_mask = (deliver_ok & part_on
-                    & (tables.side[:N][None, :, None] != side_dst))
-        deliver_ok = deliver_ok & ~sup_mask
-    if p.packet_loss_rate > 0.0:
-        basis_e = round_basis_arr(p.impair_seed, it, SALT_EDGE, jnp)
-        ue = edge_u32_arr(basis_e, iota_n.astype(jnp.uint32)[:, :, None],
-                          peerF.astype(jnp.uint32), jnp)
-        drop_mask = deliver_ok & (ue.astype(jnp.uint64)
-                                  < rate_threshold(p.packet_loss_rate))
-        deliver_ok = deliver_ok & ~drop_mask
-    tgt = jnp.where(deliver_ok, peerF, N)                        # [O,N,F]
-    tgtf = tgt.reshape(O, NF)
-    pseudo_t = jnp.broadcast_to(iota_n, (O, N))
+    with jax.named_scope("round/bfs_propagate"):
+        # ---- BFS frontier relaxation: two 1-key sorts per hop ---------------
+        # Hop-1 seed: the origin's own targets are a tiny slice, so the loop
+        # starts at hop 1 and each iteration costs only edge-key perturbation +
+        # two 1-key sorts over the (static) edge/pseudo key base.
+        tgt2_base = jnp.concatenate(
+            [jnp.where(tgt < N, tgt * 2, BIG - 1).reshape(O, NF),
+             pseudo_t * 2 + 1], axis=1)                              # [O, NF+N]
+        org_tgts = tgt[o1[:, None], origins[:, None],
+                       jnp.arange(F)[None, :]]                       # [O, F]
+        dist0 = jnp.full((O, N), INF, jnp.int32).at[o1, origins].set(0)
+        dist0 = dist0.at[o1[:, None], org_tgts].min(1, mode="drop")
+        frontier1 = jnp.zeros((O, N), bool).at[
+            o1[:, None], org_tgts].set(True, mode="drop")
+        reached1 = frontier1.at[o1, origins].set(True)
 
-    # ---- BFS frontier relaxation: two 1-key sorts per hop ---------------
-    # Hop-1 seed: the origin's own targets are a tiny slice, so the loop
-    # starts at hop 1 and each iteration costs only edge-key perturbation +
-    # two 1-key sorts over the (static) edge/pseudo key base.
-    tgt2_base = jnp.concatenate(
-        [jnp.where(tgt < N, tgt * 2, BIG - 1).reshape(O, NF),
-         pseudo_t * 2 + 1], axis=1)                              # [O, NF+N]
-    org_tgts = tgt[o1[:, None], origins[:, None],
-                   jnp.arange(F)[None, :]]                       # [O, F]
-    dist0 = jnp.full((O, N), INF, jnp.int32).at[o1, origins].set(0)
-    dist0 = dist0.at[o1[:, None], org_tgts].min(1, mode="drop")
-    frontier1 = jnp.zeros((O, N), bool).at[
-        o1[:, None], org_tgts].set(True, mode="drop")
-    reached1 = frontier1.at[o1, origins].set(True)
+        def bfs_body(carry):
+            frontier, reached, dist, h = carry
+            quiet = jnp.broadcast_to((~frontier)[:, :, None],
+                                     (O, N, F)).reshape(O, NF)
+            delta = jnp.concatenate(
+                [quiet.astype(jnp.int32), jnp.zeros((O, N), jnp.int32)], axis=1)
+            (s1,) = lax.sort((tgt2_base + delta,), dimension=-1, num_keys=1)
+            k2 = jnp.where(_boundary(s1 >> 1), s1, BIG)
+            (s2,) = lax.sort((k2,), dimension=-1, num_keys=1)
+            dense = s2[:, :N]                 # keys t*2 + (1 - any), t ascending
+            newly = ((dense & 1) == 0) & ~reached
+            dist = jnp.where(newly, h + 1, dist)
+            return (newly, reached | newly, dist, h + 1)
 
-    def bfs_body(carry):
-        frontier, reached, dist, h = carry
-        quiet = jnp.broadcast_to((~frontier)[:, :, None],
-                                 (O, N, F)).reshape(O, NF)
-        delta = jnp.concatenate(
-            [quiet.astype(jnp.int32), jnp.zeros((O, N), jnp.int32)], axis=1)
-        (s1,) = lax.sort((tgt2_base + delta,), dimension=-1, num_keys=1)
-        k2 = jnp.where(_boundary(s1 >> 1), s1, BIG)
-        (s2,) = lax.sort((k2,), dimension=-1, num_keys=1)
-        dense = s2[:, :N]                 # keys t*2 + (1 - any), t ascending
-        newly = ((dense & 1) == 0) & ~reached
-        dist = jnp.where(newly, h + 1, dist)
-        return (newly, reached | newly, dist, h + 1)
+        _, reached, dist, _ = lax.while_loop(
+            lambda c: jnp.any(c[0]), bfs_body,
+            (frontier1, reached1, dist0, jnp.int32(1)))
 
-    _, reached, dist, _ = lax.while_loop(
-        lambda c: jnp.any(c[0]), bfs_body,
-        (frontier1, reached1, dist0, jnp.int32(1)))
+    with jax.named_scope("round/verb2_consume"):
+        # ---- delivered edges + verb 2: consume (gossip.rs:618-653) ----------
+        delivered = (tgt < N) & reached[:, :, None]                  # [O,N,F]
+        deg_out = jnp.sum(delivered, axis=-1, dtype=jnp.int32)       # egress
+        m_push = jnp.sum(deg_out, axis=-1, dtype=jnp.int32)          # [O]
+        n_reached = jnp.sum(reached, axis=-1, dtype=jnp.int32)       # [O]
+        # degraded-delivery counters: only sends from reached sources exist
+        # (the oracle's BFS likewise only attempts pushes from visited nodes)
+        zero_o = jnp.zeros((O,), jnp.int32)
+        dropped_cnt = (jnp.sum(drop_mask & reached[:, :, None], axis=(1, 2),
+                               dtype=jnp.int32) if drop_mask is not None
+                       else zero_o)
+        suppressed_cnt = (jnp.sum(sup_mask & reached[:, :, None], axis=(1, 2),
+                                  dtype=jnp.int32) if sup_mask is not None
+                          else zero_o)
 
-    # ---- delivered edges + verb 2: consume (gossip.rs:618-653) ----------
-    delivered = (tgt < N) & reached[:, :, None]                  # [O,N,F]
-    deg_out = jnp.sum(delivered, axis=-1, dtype=jnp.int32)       # egress
-    m_push = jnp.sum(deg_out, axis=-1, dtype=jnp.int32)          # [O]
-    n_reached = jnp.sum(reached, axis=-1, dtype=jnp.int32)       # [O]
-    # degraded-delivery counters: only sends from reached sources exist
-    # (the oracle's BFS likewise only attempts pushes from visited nodes)
-    zero_o = jnp.zeros((O,), jnp.int32)
-    dropped_cnt = (jnp.sum(drop_mask & reached[:, :, None], axis=(1, 2),
-                           dtype=jnp.int32) if drop_mask is not None
-                   else zero_o)
-    suppressed_cnt = (jnp.sum(sup_mask & reached[:, :, None], axis=(1, 2),
-                              dtype=jnp.int32) if sup_mask is not None
-                      else zero_o)
+        hop1 = jnp.minimum(dist + 1, H - 1)                          # [O,N] per src
+        # per-edge payloads, src-major (free broadcasts)
+        kv = ((hop1[:, :, None] << pb) | iota_n[:, :, None]).astype(jnp.int32)
+        kv = jnp.broadcast_to(kv, (O, N, F)).reshape(O, NF)
+        shi_e = jnp.broadcast_to(tables.shi[None, :N, None], (O, N, F)).reshape(O, NF)
+        slo_e = jnp.broadcast_to(tables.slo[None, :N, None], (O, N, F)).reshape(O, NF)
+        kd = jnp.where(delivered, tgt, N).reshape(O, NF)
+        # one pseudo-edge per target (ranks after real: kv = BIG)
+        kd_c = jnp.concatenate([kd, pseudo_t], axis=1)               # [O, M1]
+        kv_c = jnp.concatenate([kv, jnp.full((O, N), BIG)], axis=1)
+        shi_c = jnp.concatenate([shi_e, jnp.zeros((O, N), jnp.int32)], axis=1)
+        slo_c = jnp.concatenate([slo_e, jnp.zeros((O, N), jnp.int32)], axis=1)
+        # rank inbound by (hop, src index) — index order equals the reference's
+        # pubkey-string sort by NodeIndex construction (gossip.rs:638-645)
+        st_, skv, shi_s, slo_s = lax.sort(
+            (kd_c, kv_c, shi_c, slo_c), dimension=-1, num_keys=2)
+        rank = _rank_in_run(st_)
+        is_pseudo = (skv == BIG) & (st_ < N)
+        real = (skv != BIG) & (st_ < N)
 
-    hop1 = jnp.minimum(dist + 1, H - 1)                          # [O,N] per src
-    # per-edge payloads, src-major (free broadcasts)
-    kv = ((hop1[:, :, None] << pb) | iota_n[:, :, None]).astype(jnp.int32)
-    kv = jnp.broadcast_to(kv, (O, N, F)).reshape(O, NF)
-    shi_e = jnp.broadcast_to(tables.shi[None, :N, None], (O, N, F)).reshape(O, NF)
-    slo_e = jnp.broadcast_to(tables.slo[None, :N, None], (O, N, F)).reshape(O, NF)
-    kd = jnp.where(delivered, tgt, N).reshape(O, NF)
-    # one pseudo-edge per target (ranks after real: kv = BIG)
-    kd_c = jnp.concatenate([kd, pseudo_t], axis=1)               # [O, M1]
-    kv_c = jnp.concatenate([kv, jnp.full((O, N), BIG)], axis=1)
-    shi_c = jnp.concatenate([shi_e, jnp.zeros((O, N), jnp.int32)], axis=1)
-    slo_c = jnp.concatenate([slo_e, jnp.zeros((O, N), jnp.int32)], axis=1)
-    # rank inbound by (hop, src index) — index order equals the reference's
-    # pubkey-string sort by NodeIndex construction (gossip.rs:638-645)
-    st_, skv, shi_s, slo_s = lax.sort(
-        (kd_c, kv_c, shi_c, slo_c), dimension=-1, num_keys=2)
-    rank = _rank_in_run(st_)
-    is_pseudo = (skv == BIG) & (st_ < N)
-    real = (skv != BIG) & (st_ < N)
+        # ingress counts: the pseudo entry sorts last in its run, so its rank is
+        # the number of delivered edges into its target; compact runs -> [O, N].
+        ing_k = jnp.where(is_pseudo, st_, BIG)
+        _, ing_cnt = lax.sort((ing_k, rank), dimension=-1, num_keys=1)
+        ingress_round = ing_cnt[:, :N]                               # [O, N]
+        inb_dropped = jnp.sum(real & (rank >= K), axis=-1, dtype=jnp.int32)
 
-    # ingress counts: the pseudo entry sorts last in its run, so its rank is
-    # the number of delivered edges into its target; compact runs -> [O, N].
-    ing_k = jnp.where(is_pseudo, st_, BIG)
-    _, ing_cnt = lax.sort((ing_k, rank), dimension=-1, num_keys=1)
-    ingress_round = ing_cnt[:, :N]                               # [O, N]
-    inb_dropped = jnp.sum(real & (rank >= K), axis=-1, dtype=jnp.int32)
+        # inbound rows [O, N, K] via slot-aligned two-sort compaction
+        keep = real & (rank < K)
+        gk = jnp.where(keep, (st_ * K + rank) * 2, BIG)
+        slot_keys = jnp.broadcast_to(
+            jnp.arange(NK, dtype=jnp.int32)[None, :] * 2 + 1, (O, NK))
+        ga = jnp.concatenate([gk, slot_keys], axis=1)
+        kv_a = jnp.concatenate([skv, jnp.full((O, NK), BIG)], axis=1)
+        shi_a = jnp.concatenate([shi_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
+        slo_a = jnp.concatenate([slo_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
+        sA, kvA, hiA, loA = lax.sort((ga, kv_a, shi_a, slo_a),
+                                     dimension=-1, num_keys=1)
+        gB = jnp.where(_boundary(sA >> 1), sA, BIG)
+        sB, kvB, hiB, loB = lax.sort((gB, kvA, hiA, loA),
+                                     dimension=-1, num_keys=1)
+        inb_real = (sB[:, :NK] & 1) == 0
+        inb = jnp.where(inb_real, kvB[:, :NK] & (pack - 1), N).reshape(O, N, K)
+        inb_shi = jnp.where(inb_real, hiB[:, :NK], 0).reshape(O, N, K)
+        inb_slo = jnp.where(inb_real, loB[:, :NK], 0).reshape(O, N, K)
 
-    # inbound rows [O, N, K] via slot-aligned two-sort compaction
-    keep = real & (rank < K)
-    gk = jnp.where(keep, (st_ * K + rank) * 2, BIG)
-    slot_keys = jnp.broadcast_to(
-        jnp.arange(NK, dtype=jnp.int32)[None, :] * 2 + 1, (O, NK))
-    ga = jnp.concatenate([gk, slot_keys], axis=1)
-    kv_a = jnp.concatenate([skv, jnp.full((O, NK), BIG)], axis=1)
-    shi_a = jnp.concatenate([shi_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
-    slo_a = jnp.concatenate([slo_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
-    sA, kvA, hiA, loA = lax.sort((ga, kv_a, shi_a, slo_a),
-                                 dimension=-1, num_keys=1)
-    gB = jnp.where(_boundary(sA >> 1), sA, BIG)
-    sB, kvB, hiB, loB = lax.sort((gB, kvA, hiA, loA),
-                                 dimension=-1, num_keys=1)
-    inb_real = (sB[:, :NK] & 1) == 0
-    inb = jnp.where(inb_real, kvB[:, :NK] & (pack - 1), N).reshape(O, N, K)
-    inb_shi = jnp.where(inb_real, hiB[:, :NK], 0).reshape(O, N, K)
-    inb_slo = jnp.where(inb_real, loB[:, :NK], 0).reshape(O, N, K)
+    with jax.named_scope("round/rc_merge"):
+        # ---- received-cache merge (received_cache.rs:83-98) -----------------
+        rc_src, rc_score = state.rc_src, state.rc_score
+        rc_shi, rc_slo = state.rc_shi, state.rc_slo
+        kpos = jnp.arange(K, dtype=jnp.int32)[None, None, :]
 
-    # ---- received-cache merge (received_cache.rs:83-98) -----------------
-    rc_src, rc_score = state.rc_src, state.rc_score
-    rc_shi, rc_slo = state.rc_shi, state.rc_slo
-    kpos = jnp.arange(K, dtype=jnp.int32)[None, None, :]
+        # member lookup: one row sort by (src, tag), route flags back by slot
+        fk = jnp.concatenate([rc_src * 2, inb * 2 + 1], axis=-1)     # [O,N,C+K]
+        fpos = jnp.concatenate(
+            [jnp.broadcast_to(jnp.full((1, 1, C), BIG), (O, N, C)),
+             jnp.broadcast_to(kpos, (O, N, K))], axis=-1)
+        fk_s, fpos_s = lax.sort((fk, fpos), dimension=-1, num_keys=1)
+        dup_s = jnp.concatenate(
+            [jnp.zeros((O, N, 1), bool),
+             (fk_s[..., 1:] >> 1) == (fk_s[..., :-1] >> 1)], axis=-1)
+        back_k, back_d = lax.sort(
+            (fpos_s, dup_s.astype(jnp.int32)), dimension=-1, num_keys=1)
+        found = (back_d[..., :K] == 1) & (inb < N)                   # [O,N,K]
 
-    # member lookup: one row sort by (src, tag), route flags back by slot
-    fk = jnp.concatenate([rc_src * 2, inb * 2 + 1], axis=-1)     # [O,N,C+K]
-    fpos = jnp.concatenate(
-        [jnp.broadcast_to(jnp.full((1, 1, C), BIG), (O, N, C)),
-         jnp.broadcast_to(kpos, (O, N, K))], axis=-1)
-    fk_s, fpos_s = lax.sort((fk, fpos), dimension=-1, num_keys=1)
-    dup_s = jnp.concatenate(
-        [jnp.zeros((O, N, 1), bool),
-         (fk_s[..., 1:] >> 1) == (fk_s[..., :-1] >> 1)], axis=-1)
-    back_k, back_d = lax.sort(
-        (fpos_s, dup_s.astype(jnp.int32)), dimension=-1, num_keys=1)
-    found = (back_d[..., :K] == 1) & (inb < N)                   # [O,N,K]
+        # rank-order capacity scan (received_cache.rs:92-97): scored ranks (< 2)
+        # insert unconditionally; the rest honor the 50-entry cap
+        base_len = jnp.sum(rc_src < N, axis=-1, dtype=jnp.int32)
+        want = (inb < N) & ~found
+        ln = base_len
+        allowed_cols = []
+        for r in range(K):
+            a = want[..., r] & ((r < 2) | (ln < p.received_cap))
+            allowed_cols.append(a)
+            ln = ln + a.astype(jnp.int32)
+        allowed = jnp.stack(allowed_cols, axis=-1)                   # [O,N,K]
 
-    # rank-order capacity scan (received_cache.rs:92-97): scored ranks (< 2)
-    # insert unconditionally; the rest honor the 50-entry cap
-    base_len = jnp.sum(rc_src < N, axis=-1, dtype=jnp.int32)
-    want = (inb < N) & ~found
-    ln = base_len
-    allowed_cols = []
-    for r in range(K):
-        a = want[..., r] & ((r < 2) | (ln < p.received_cap))
-        allowed_cols.append(a)
-        ln = ln + a.astype(jnp.int32)
-    allowed = jnp.stack(allowed_cols, axis=-1)                   # [O,N,K]
+        # merge rows: score-bump carriers (found & rank<2) + allowed inserts
+        bump = found & (kpos < 2)
+        include = bump | allowed
+        contrib = (kpos < 2).astype(jnp.int32)                       # +1 / score 1
+        mk = jnp.concatenate(
+            [jnp.where(rc_src < N, rc_src * 2, BIG),
+             jnp.where(include, inb * 2 + 1, BIG)], axis=-1)         # [O,N,C+K]
+        msc = jnp.concatenate(
+            [rc_score, jnp.where(include, contrib, 0)], axis=-1)
+        mhi = jnp.concatenate([rc_shi, inb_shi], axis=-1)
+        mlo = jnp.concatenate([rc_slo, inb_slo], axis=-1)
+        mk_s, msc_s, mhi_s, mlo_s = lax.sort(
+            (mk, msc, mhi, mlo), dimension=-1, num_keys=1)
+        is_dup = jnp.concatenate(
+            [jnp.zeros((O, N, 1), bool),
+             ((mk_s[..., 1:] >> 1) == (mk_s[..., :-1] >> 1))
+             & ((mk_s[..., 1:] & 1) == 1)], axis=-1)
+        nxt_dup = jnp.concatenate([is_dup[..., 1:],
+                                   jnp.zeros((O, N, 1), bool)], axis=-1)
+        nxt_sc = jnp.concatenate([msc_s[..., 1:],
+                                  jnp.zeros((O, N, 1), jnp.int32)], axis=-1)
+        msc_s = msc_s + jnp.where(nxt_dup, nxt_sc, 0)                # bump old
+        valid_m = (mk_s != BIG) & ~is_dup
+        ck = jnp.where(valid_m, mk_s >> 1, BIG)
+        ck_s, csc, chi, clo = lax.sort(
+            (ck, msc_s, mhi_s, mlo_s), dimension=-1, num_keys=1)
+        n_valid = jnp.sum(valid_m, axis=-1, dtype=jnp.int32)
+        rc_overflow = jnp.sum(jnp.maximum(n_valid - C, 0), axis=(-1,),
+                              dtype=jnp.int32)
+        rc_src = jnp.where(ck_s[..., :C] != BIG, ck_s[..., :C], N)
+        rc_score = jnp.where(ck_s[..., :C] != BIG, csc[..., :C], 0)
+        rc_shi = jnp.where(ck_s[..., :C] != BIG, chi[..., :C], 0)
+        rc_slo = jnp.where(ck_s[..., :C] != BIG, clo[..., :C], 0)
 
-    # merge rows: score-bump carriers (found & rank<2) + allowed inserts
-    bump = found & (kpos < 2)
-    include = bump | allowed
-    contrib = (kpos < 2).astype(jnp.int32)                       # +1 / score 1
-    mk = jnp.concatenate(
-        [jnp.where(rc_src < N, rc_src * 2, BIG),
-         jnp.where(include, inb * 2 + 1, BIG)], axis=-1)         # [O,N,C+K]
-    msc = jnp.concatenate(
-        [rc_score, jnp.where(include, contrib, 0)], axis=-1)
-    mhi = jnp.concatenate([rc_shi, inb_shi], axis=-1)
-    mlo = jnp.concatenate([rc_slo, inb_slo], axis=-1)
-    mk_s, msc_s, mhi_s, mlo_s = lax.sort(
-        (mk, msc, mhi, mlo), dimension=-1, num_keys=1)
-    is_dup = jnp.concatenate(
-        [jnp.zeros((O, N, 1), bool),
-         ((mk_s[..., 1:] >> 1) == (mk_s[..., :-1] >> 1))
-         & ((mk_s[..., 1:] & 1) == 1)], axis=-1)
-    nxt_dup = jnp.concatenate([is_dup[..., 1:],
-                               jnp.zeros((O, N, 1), bool)], axis=-1)
-    nxt_sc = jnp.concatenate([msc_s[..., 1:],
-                              jnp.zeros((O, N, 1), jnp.int32)], axis=-1)
-    msc_s = msc_s + jnp.where(nxt_dup, nxt_sc, 0)                # bump old
-    valid_m = (mk_s != BIG) & ~is_dup
-    ck = jnp.where(valid_m, mk_s >> 1, BIG)
-    ck_s, csc, chi, clo = lax.sort(
-        (ck, msc_s, mhi_s, mlo_s), dimension=-1, num_keys=1)
-    n_valid = jnp.sum(valid_m, axis=-1, dtype=jnp.int32)
-    rc_overflow = jnp.sum(jnp.maximum(n_valid - C, 0), axis=(-1,),
-                          dtype=jnp.int32)
-    rc_src = jnp.where(ck_s[..., :C] != BIG, ck_s[..., :C], N)
-    rc_score = jnp.where(ck_s[..., :C] != BIG, csc[..., :C], 0)
-    rc_shi = jnp.where(ck_s[..., :C] != BIG, chi[..., :C], 0)
-    rc_slo = jnp.where(ck_s[..., :C] != BIG, clo[..., :C], 0)
+        any_inb = inb[..., 0] < N  # a rank-0 record is one upsert (received_cache.rs:85-87)
+        rc_ups = state.rc_upserts + any_inb.astype(jnp.int32)
 
-    any_inb = inb[..., 0] < N  # a rank-0 record is one upsert (received_cache.rs:85-87)
-    rc_ups = state.rc_upserts + any_inb.astype(jnp.int32)
+    with jax.named_scope("round/verb3_prune_decide"):
+        # ---- verb 3: prune decide (received_cache.rs:38-63,100-131) ---------
+        fired = rc_ups >= p.min_num_upserts
+        stake_dest = tables.stakes[:N][None, :]                      # [1, N] i64
+        stake_org = tables.stakes[origins][:, None]                  # [O, 1]
+        min_stake = jnp.minimum(stake_dest, stake_org)
+        # f64 multiply then u64 truncation, as the reference does
+        # (received_cache.rs:112-115).
+        min_ingress_stake = (min_stake.astype(jnp.float64)
+                             * p.prune_stake_threshold).astype(jnp.int64)
 
-    # ---- verb 3: prune decide (received_cache.rs:38-63,100-131) ---------
-    fired = rc_ups >= p.min_num_upserts
-    stake_dest = tables.stakes[:N][None, :]                      # [1, N] i64
-    stake_org = tables.stakes[origins][:, None]                  # [O, 1]
-    min_stake = jnp.minimum(stake_dest, stake_org)
-    # f64 multiply then u64 truncation, as the reference does
-    # (received_cache.rs:112-115).
-    min_ingress_stake = (min_stake.astype(jnp.float64)
-                         * p.prune_stake_threshold).astype(jnp.int64)
+        member = rc_src < N
+        mx = jnp.iinfo(jnp.int32).max
+        neg_score = jnp.where(member, -rc_score, mx)
+        neg_hi = jnp.where(member, -rc_shi, mx)
+        neg_lo = jnp.where(member, -rc_slo, mx)
+        # (score desc, stake desc, src asc): stake split keeps i64 out of the sort
+        _, _, _, src_sorted, hi_sorted, lo_sorted = lax.sort(
+            (neg_score, neg_hi, neg_lo, rc_src, rc_shi, rc_slo),
+            dimension=-1, num_keys=4)
+        memb_sorted = src_sorted < N
+        stake_sorted = (hi_sorted.astype(jnp.int64) << 31) | lo_sorted.astype(
+            jnp.int64)
+        cum_excl = jnp.cumsum(stake_sorted, axis=-1) - stake_sorted
+        posn = jnp.arange(C)[None, None, :]
+        pruned_slot = (memb_sorted
+                       & (posn >= p.min_ingress_nodes)
+                       & (cum_excl >= min_ingress_stake[..., None])
+                       & (src_sorted != origin_col)
+                       & fired[..., None])
+        n_pruned = jnp.sum(pruned_slot, axis=-1, dtype=jnp.int32)    # [O, N] per pruner
+        m_prunes = jnp.sum(n_pruned, axis=-1, dtype=jnp.int32)       # [O]
+        # Prune messages count toward RMR's m (gossip.rs:684-687).
 
-    member = rc_src < N
-    mx = jnp.iinfo(jnp.int32).max
-    neg_score = jnp.where(member, -rc_score, mx)
-    neg_hi = jnp.where(member, -rc_shi, mx)
-    neg_lo = jnp.where(member, -rc_slo, mx)
-    # (score desc, stake desc, src asc): stake split keeps i64 out of the sort
-    _, _, _, src_sorted, hi_sorted, lo_sorted = lax.sort(
-        (neg_score, neg_hi, neg_lo, rc_src, rc_shi, rc_slo),
-        dimension=-1, num_keys=4)
-    memb_sorted = src_sorted < N
-    stake_sorted = (hi_sorted.astype(jnp.int64) << 31) | lo_sorted.astype(
-        jnp.int64)
-    cum_excl = jnp.cumsum(stake_sorted, axis=-1) - stake_sorted
-    posn = jnp.arange(C)[None, None, :]
-    pruned_slot = (memb_sorted
-                   & (posn >= p.min_ingress_nodes)
-                   & (cum_excl >= min_ingress_stake[..., None])
-                   & (src_sorted != origin_col)
-                   & fired[..., None])
-    n_pruned = jnp.sum(pruned_slot, axis=-1, dtype=jnp.int32)    # [O, N] per pruner
-    m_prunes = jnp.sum(n_pruned, axis=-1, dtype=jnp.int32)       # [O]
-    # Prune messages count toward RMR's m (gossip.rs:684-687).
+    with jax.named_scope("round/verb4_prune_apply"):
+        # ---- verb 4: prune apply (push_active_set.rs:56-71,143-151) ---------
+        # pair (pruner=t, prunee=u) must set prunee u's slot bit for peer t:
+        # match key = peer * pack + owner, shared by pairs and active-set edges.
+        NP = min(p.pa_slots, C)
+        pk_rows = jnp.where(pruned_slot, posn.astype(jnp.int32), C)
+        pk_s, psrc_s = lax.sort((pk_rows, src_sorted), dimension=-1, num_keys=1)
+        over_budget = jnp.any(pk_s[..., NP:NP + 1] < C) if NP < C else jnp.array(
+            False)
+        t_rows = jnp.broadcast_to(iota_n[:, :, None], (O, N, C))
+        pair_live = pk_s < C
 
-    # ---- verb 4: prune apply (push_active_set.rs:56-71,143-151) ---------
-    # pair (pruner=t, prunee=u) must set prunee u's slot bit for peer t:
-    # match key = peer * pack + owner, shared by pairs and active-set edges.
-    NP = min(p.pa_slots, C)
-    pk_rows = jnp.where(pruned_slot, posn.astype(jnp.int32), C)
-    pk_s, psrc_s = lax.sort((pk_rows, src_sorted), dimension=-1, num_keys=1)
-    over_budget = jnp.any(pk_s[..., NP:NP + 1] < C) if NP < C else jnp.array(
-        False)
-    t_rows = jnp.broadcast_to(iota_n[:, :, None], (O, N, C))
-    pair_live = pk_s < C
+        edge_keys = (jnp.minimum(peer, N - 1) * pack
+                     + iota_n[:, :, None]).reshape(O, N * S)
+        edge_keys = jnp.where(is_peer.reshape(O, N * S), edge_keys * 2 + 1, BIG)
+        edge_pos = jnp.broadcast_to(
+            jnp.arange(N * S, dtype=jnp.int32)[None, :], (O, N * S))
 
-    edge_keys = (jnp.minimum(peer, N - 1) * pack
-                 + iota_n[:, :, None]).reshape(O, N * S)
-    edge_keys = jnp.where(is_peer.reshape(O, N * S), edge_keys * 2 + 1, BIG)
-    edge_pos = jnp.broadcast_to(
-        jnp.arange(N * S, dtype=jnp.int32)[None, :], (O, N * S))
+        def _apply(np_slots):
+            pair_keys = jnp.where(
+                pair_live[..., :np_slots],
+                (t_rows[..., :np_slots] * pack + psrc_s[..., :np_slots]) * 2,
+                BIG).reshape(O, N * np_slots)
+            # pair key = pruner*pack + prunee; edge key = peer*pack + owner:
+            # a hit means this slot's peer has pruned the owner for this origin
+            k = jnp.concatenate([edge_keys, pair_keys], axis=1)
+            ppos = jnp.concatenate(
+                [edge_pos, jnp.full((O, N * np_slots), BIG)], axis=1)
+            ks, pos_s = lax.sort((k, ppos), dimension=-1, num_keys=1)
+            hit_s = jnp.concatenate(
+                [jnp.zeros((O, 1), bool),
+                 ((ks[:, 1:] >> 1) == (ks[:, :-1] >> 1))
+                 & ((ks[:, 1:] & 1) == 1)], axis=1)
+            _, hit_back = lax.sort((pos_s, hit_s.astype(jnp.int32)),
+                                   dimension=-1, num_keys=1)
+            return hit_back[:, :N * S].reshape(O, N, S) == 1
 
-    def _apply(np_slots):
-        pair_keys = jnp.where(
-            pair_live[..., :np_slots],
-            (t_rows[..., :np_slots] * pack + psrc_s[..., :np_slots]) * 2,
-            BIG).reshape(O, N * np_slots)
-        # pair key = pruner*pack + prunee; edge key = peer*pack + owner:
-        # a hit means this slot's peer has pruned the owner for this origin
-        k = jnp.concatenate([edge_keys, pair_keys], axis=1)
-        ppos = jnp.concatenate(
-            [edge_pos, jnp.full((O, N * np_slots), BIG)], axis=1)
-        ks, pos_s = lax.sort((k, ppos), dimension=-1, num_keys=1)
-        hit_s = jnp.concatenate(
-            [jnp.zeros((O, 1), bool),
-             ((ks[:, 1:] >> 1) == (ks[:, :-1] >> 1))
-             & ((ks[:, 1:] & 1) == 1)], axis=1)
-        _, hit_back = lax.sort((pos_s, hit_s.astype(jnp.int32)),
-                               dimension=-1, num_keys=1)
-        return hit_back[:, :N * S].reshape(O, N, S) == 1
+        if NP < C:
+            hit = lax.cond(over_budget, lambda: _apply(C), lambda: _apply(NP))
+        else:
+            hit = _apply(C)
+        pruned_bits = state.pruned | (hit & is_peer)
 
-    if NP < C:
-        hit = lax.cond(over_budget, lambda: _apply(C), lambda: _apply(NP))
-    else:
-        hit = _apply(C)
-    pruned_bits = state.pruned | (hit & is_peer)
+        # mem::take on fire: the whole entry resets (received_cache.rs:48-55)
+        rc_src = jnp.where(fired[..., None], N, rc_src)
+        rc_score = jnp.where(fired[..., None], 0, rc_score)
+        rc_shi = jnp.where(fired[..., None], 0, rc_shi)
+        rc_slo = jnp.where(fired[..., None], 0, rc_slo)
+        rc_ups = jnp.where(fired, 0, rc_ups)
 
-    # mem::take on fire: the whole entry resets (received_cache.rs:48-55)
-    rc_src = jnp.where(fired[..., None], N, rc_src)
-    rc_score = jnp.where(fired[..., None], 0, rc_score)
-    rc_shi = jnp.where(fired[..., None], 0, rc_shi)
-    rc_slo = jnp.where(fired[..., None], 0, rc_slo)
-    rc_ups = jnp.where(fired, 0, rc_ups)
+    with jax.named_scope("round/verb5_rotate"):
+        # ---- verb 5: rotate (gossip.rs:739-754; push_active_set.rs:153-186) -
+        rot_u = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
+            subs[:, 1])
+        rotate = rot_u < p.probability_of_rotation
+        T = p.rot_tries
+        u_all = jax.vmap(
+            lambda ks: jax.vmap(
+                lambda k: jax.random.uniform(k, (N, 2), dtype=jnp.float32))(ks)
+        )(subs[:, 2:2 + T])                                          # [O, T, N, 2]
+        u_all = jnp.moveaxis(u_all, 1, 2)                            # [O, N, T, 2]
+        members = _sample_fast(tables, origins, u_all[..., 0], u_all[..., 1])
+        perm_t = jnp.broadcast_to(tables.sampler.perm[None, :], (O, N))
+        cands = _lookup(perm_t, members.reshape(O, N * T), N,
+                        pack).reshape(O, N, T)
 
-    # ---- verb 5: rotate (gossip.rs:739-754; push_active_set.rs:153-186) -
-    rot_u = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
-        subs[:, 1])
-    rotate = rot_u < p.probability_of_rotation
-    T = p.rot_tries
-    u_all = jax.vmap(
-        lambda ks: jax.vmap(
-            lambda k: jax.random.uniform(k, (N, 2), dtype=jnp.float32))(ks)
-    )(subs[:, 2:2 + T])                                          # [O, T, N, 2]
-    u_all = jnp.moveaxis(u_all, 1, 2)                            # [O, N, T, 2]
-    members = _sample_fast(tables, origins, u_all[..., 0], u_all[..., 1])
-    perm_t = jnp.broadcast_to(tables.sampler.perm[None, :], (O, N))
-    cands = _lookup(perm_t, members.reshape(O, N * T), N,
-                    pack).reshape(O, N, T)
+        chosen = jnp.full((O, N), N, jnp.int32)
+        found_new = jnp.zeros((O, N), bool)
+        self_i = jnp.arange(N, dtype=jnp.int32)[None, :]
+        active_now = peer
+        for t in range(T):
+            cand = cands[..., t]
+            ok = ((cand != self_i)
+                  & ~jnp.any(active_now == cand[..., None], axis=-1))
+            take = ok & ~found_new
+            chosen = jnp.where(take, cand, chosen)
+            found_new = found_new | ok
+        do_rot = rotate & found_new
+        rot_failed = jnp.sum(rotate & ~found_new, axis=-1, dtype=jnp.int32)
+        chosen_failed = _lookup(
+            failed.astype(jnp.int32), jnp.minimum(chosen, N - 1), N, pack) == 1
 
-    chosen = jnp.full((O, N), N, jnp.int32)
-    found_new = jnp.zeros((O, N), bool)
-    self_i = jnp.arange(N, dtype=jnp.int32)[None, :]
-    active_now = peer
-    for t in range(T):
-        cand = cands[..., t]
-        ok = ((cand != self_i)
-              & ~jnp.any(active_now == cand[..., None], axis=-1))
-        take = ok & ~found_new
-        chosen = jnp.where(take, cand, chosen)
-        found_new = found_new | ok
-    do_rot = rotate & found_new
-    rot_failed = jnp.sum(rotate & ~found_new, axis=-1, dtype=jnp.int32)
-    chosen_failed = _lookup(
-        failed.astype(jnp.int32), jnp.minimum(chosen, N - 1), N, pack) == 1
+        mcnt = jnp.sum(active_now < N, axis=-1, dtype=jnp.int32)
+        full_row = mcnt >= S
+        shift_act = jnp.concatenate([active_now[..., 1:], chosen[..., None]], axis=-1)
+        shift_prn = jnp.concatenate(
+            [pruned_bits[..., 1:], jnp.zeros((O, N, 1), bool)], axis=-1)
+        shift_tf = jnp.concatenate(
+            [tfail[..., 1:], chosen_failed[..., None]], axis=-1)
+        slot_oh = (jnp.arange(S)[None, None, :] == jnp.minimum(mcnt, S - 1)[..., None])
+        append_act = jnp.where(slot_oh & ~full_row[..., None],
+                               chosen[..., None], active_now)
+        append_tf = jnp.where(slot_oh & ~full_row[..., None],
+                              chosen_failed[..., None], tfail)
+        new_active = jnp.where(do_rot[..., None],
+                               jnp.where(full_row[..., None], shift_act, append_act),
+                               active_now)
+        new_pruned = jnp.where((do_rot & full_row)[..., None], shift_prn, pruned_bits)
+        new_tfail = jnp.where(do_rot[..., None],
+                              jnp.where(full_row[..., None], shift_tf, append_tf),
+                              tfail)
 
-    mcnt = jnp.sum(active_now < N, axis=-1, dtype=jnp.int32)
-    full_row = mcnt >= S
-    shift_act = jnp.concatenate([active_now[..., 1:], chosen[..., None]], axis=-1)
-    shift_prn = jnp.concatenate(
-        [pruned_bits[..., 1:], jnp.zeros((O, N, 1), bool)], axis=-1)
-    shift_tf = jnp.concatenate(
-        [tfail[..., 1:], chosen_failed[..., None]], axis=-1)
-    slot_oh = (jnp.arange(S)[None, None, :] == jnp.minimum(mcnt, S - 1)[..., None])
-    append_act = jnp.where(slot_oh & ~full_row[..., None],
-                           chosen[..., None], active_now)
-    append_tf = jnp.where(slot_oh & ~full_row[..., None],
-                          chosen_failed[..., None], tfail)
-    new_active = jnp.where(do_rot[..., None],
-                           jnp.where(full_row[..., None], shift_act, append_act),
-                           active_now)
-    new_pruned = jnp.where((do_rot & full_row)[..., None], shift_prn, pruned_bits)
-    new_tfail = jnp.where(do_rot[..., None],
-                          jnp.where(full_row[..., None], shift_tf, append_tf),
-                          tfail)
+    with jax.named_scope("round/round_stats"):
+        # ---- statistics (gossip_stats.rs; on-device reductions) -------------
+        hr = jnp.sum(
+            (jnp.minimum(dist, H - 1)[:, :, None] == jnp.arange(H)[None, None, :])
+            & reached[:, :, None], axis=1, dtype=jnp.int32)          # [O, H]
+        pos_counts = hr.at[:, 0].set(0)          # HopsStat filters origin's 0 hops
+        cnt = jnp.sum(pos_counts, axis=-1)
+        hsum = jnp.sum(pos_counts * jnp.arange(H)[None, :], axis=-1)
+        hop_mean = jnp.where(cnt > 0, hsum / jnp.maximum(cnt, 1), jnp.nan)
+        csum = jnp.cumsum(pos_counts[:, 1:], axis=-1)                # [O, H-1]
+        lo_i = (cnt - 1) // 2
+        hi_i = cnt // 2
+        val_of = lambda i: 1 + jnp.sum((csum <= i[:, None]).astype(jnp.int32), axis=-1)
+        hop_median = jnp.where(cnt > 0, (val_of(lo_i) + val_of(hi_i)) / 2.0, 0.0)
+        pos_hops = jnp.where(reached & (dist > 0), dist, 0)
+        hop_max = jnp.max(pos_hops, axis=-1)
+        hop_min = jnp.where(
+            cnt > 0,
+            jnp.min(jnp.where(reached & (dist > 0), dist, INF), axis=-1), 0)
 
-    # ---- statistics (gossip_stats.rs; on-device reductions) -------------
-    hr = jnp.sum(
-        (jnp.minimum(dist, H - 1)[:, :, None] == jnp.arange(H)[None, None, :])
-        & reached[:, :, None], axis=1, dtype=jnp.int32)          # [O, H]
-    pos_counts = hr.at[:, 0].set(0)          # HopsStat filters origin's 0 hops
-    cnt = jnp.sum(pos_counts, axis=-1)
-    hsum = jnp.sum(pos_counts * jnp.arange(H)[None, :], axis=-1)
-    hop_mean = jnp.where(cnt > 0, hsum / jnp.maximum(cnt, 1), jnp.nan)
-    csum = jnp.cumsum(pos_counts[:, 1:], axis=-1)                # [O, H-1]
-    lo_i = (cnt - 1) // 2
-    hi_i = cnt // 2
-    val_of = lambda i: 1 + jnp.sum((csum <= i[:, None]).astype(jnp.int32), axis=-1)
-    hop_median = jnp.where(cnt > 0, (val_of(lo_i) + val_of(hi_i)) / 2.0, 0.0)
-    pos_hops = jnp.where(reached & (dist > 0), dist, 0)
-    hop_max = jnp.max(pos_hops, axis=-1)
-    hop_min = jnp.where(
-        cnt > 0,
-        jnp.min(jnp.where(reached & (dist > 0), dist, INF), axis=-1), 0)
+        stranded = (~reached) & (~failed)
+        stranded_cnt = jnp.sum(stranded, axis=-1, dtype=jnp.int32)
+        m_total = m_push + m_prunes
+        nn = n_reached
+        rmr = jnp.where(nn > 1, m_total / jnp.maximum(nn - 1, 1) - 1.0, 0.0)
+        branching = m_push / jnp.maximum(nn, 1)   # Σ|pushes[src]| / |pushes|
 
-    stranded = (~reached) & (~failed)
-    stranded_cnt = jnp.sum(stranded, axis=-1, dtype=jnp.int32)
-    m_total = m_push + m_prunes
-    nn = n_reached
-    rmr = jnp.where(nn > 1, m_total / jnp.maximum(nn - 1, 1) - 1.0, 0.0)
-    branching = m_push / jnp.maximum(nn, 1)   # Σ|pushes[src]| / |pushes|
-
-    measured = it >= p.warm_up_rounds
-    g = measured.astype(jnp.int32)
-    new_state = SimState(
-        key=state.key,
-        active=new_active,
-        pruned=new_pruned,
-        tfail=new_tfail,
-        rc_src=rc_src,
-        rc_score=rc_score,
-        rc_shi=rc_shi,
-        rc_slo=rc_slo,
-        rc_upserts=rc_ups,
-        failed=failed,
-        egress_acc=state.egress_acc + g * deg_out,
-        ingress_acc=state.ingress_acc + g * ingress_round,
-        prune_acc=state.prune_acc + g * n_pruned,
-        stranded_acc=state.stranded_acc + g * stranded.astype(jnp.int32),
-        hops_hist_acc=state.hops_hist_acc + g * hr,
-    )
-    rows = {
-        "coverage": (n_reached / N).astype(jnp.float32),
-        "unvisited": (N - n_reached).astype(jnp.int32),
-        "m": m_total,
-        "n": nn,
-        "rmr": rmr.astype(jnp.float32),
-        "hop_mean": hop_mean.astype(jnp.float32),
-        "hop_median": hop_median.astype(jnp.float32),
-        "hop_max": hop_max.astype(jnp.int32),
-        "hop_min": hop_min.astype(jnp.int32),
-        "stranded": stranded_cnt,
-        "branching": branching.astype(jnp.float32),
-        "prunes_sent": m_prunes,
-        "inb_dropped": inb_dropped,
-        "rc_overflow": rc_overflow,
-        "rot_failed": rot_failed,
-        # degraded-delivery accounting (faults.py; all-zero when the
-        # impairment knobs are off)
-        "delivered": m_push,
-        "dropped": dropped_cnt,
-        "suppressed": suppressed_cnt,
-        "failed_count": jnp.sum(failed, axis=-1, dtype=jnp.int32),
-        # hop-histogram clamp guard: nodes whose true hop distance exceeds
-        # the last bin (dist > H - 1) and was clamped into it by the
-        # min(dist, H - 1) binning above; dist == H - 1 is that bin's
-        # legitimate value and does not count
-        "hop_clamped": jnp.sum(reached & (dist >= H), axis=-1,
-                               dtype=jnp.int32),
-    }
-    if detail:
-        rows["stranded_mask"] = stranded
-        rows["dist"] = jnp.where(reached, dist, -1).astype(jnp.int32)
-        rows["failed_mask"] = failed
-    if edge_detail:
-        # per-edge hop matrix: the engine equivalent of the reference's
-        # ``orders`` debug dump (gossip.rs:374-390) — edge (src -> tgt)
-        # delivered at hop dist[src]+1; -1 marks unsent fanout slots.
-        rows["push_targets"] = jnp.where(delivered, tgt, -1)
-        rows["edge_hops"] = jnp.where(
-            delivered, jnp.broadcast_to(hop1[:, :, None], (O, N, F)), -1)
+        measured = it >= p.warm_up_rounds
+        g = measured.astype(jnp.int32)
+        new_state = SimState(
+            key=state.key,
+            active=new_active,
+            pruned=new_pruned,
+            tfail=new_tfail,
+            rc_src=rc_src,
+            rc_score=rc_score,
+            rc_shi=rc_shi,
+            rc_slo=rc_slo,
+            rc_upserts=rc_ups,
+            failed=failed,
+            egress_acc=state.egress_acc + g * deg_out,
+            ingress_acc=state.ingress_acc + g * ingress_round,
+            prune_acc=state.prune_acc + g * n_pruned,
+            stranded_acc=state.stranded_acc + g * stranded.astype(jnp.int32),
+            hops_hist_acc=state.hops_hist_acc + g * hr,
+        )
+        rows = {
+            "coverage": (n_reached / N).astype(jnp.float32),
+            "unvisited": (N - n_reached).astype(jnp.int32),
+            "m": m_total,
+            "n": nn,
+            "rmr": rmr.astype(jnp.float32),
+            "hop_mean": hop_mean.astype(jnp.float32),
+            "hop_median": hop_median.astype(jnp.float32),
+            "hop_max": hop_max.astype(jnp.int32),
+            "hop_min": hop_min.astype(jnp.int32),
+            "stranded": stranded_cnt,
+            "branching": branching.astype(jnp.float32),
+            "prunes_sent": m_prunes,
+            "inb_dropped": inb_dropped,
+            "rc_overflow": rc_overflow,
+            "rot_failed": rot_failed,
+            # degraded-delivery accounting (faults.py; all-zero when the
+            # impairment knobs are off)
+            "delivered": m_push,
+            "dropped": dropped_cnt,
+            "suppressed": suppressed_cnt,
+            "failed_count": jnp.sum(failed, axis=-1, dtype=jnp.int32),
+            # hop-histogram clamp guard: nodes whose true hop distance exceeds
+            # the last bin (dist > H - 1) and was clamped into it by the
+            # min(dist, H - 1) binning above; dist == H - 1 is that bin's
+            # legitimate value and does not count
+            "hop_clamped": jnp.sum(reached & (dist >= H), axis=-1,
+                                   dtype=jnp.int32),
+        }
+        if detail:
+            rows["stranded_mask"] = stranded
+            rows["dist"] = jnp.where(reached, dist, -1).astype(jnp.int32)
+            rows["failed_mask"] = failed
+        if edge_detail:
+            # per-edge hop matrix: the engine equivalent of the reference's
+            # ``orders`` debug dump (gossip.rs:374-390) — edge (src -> tgt)
+            # delivered at hop dist[src]+1; -1 marks unsent fanout slots.
+            rows["push_targets"] = jnp.where(delivered, tgt, -1)
+            rows["edge_hops"] = jnp.where(
+                delivered, jnp.broadcast_to(hop1[:, :, None], (O, N, F)), -1)
     return new_state, rows
 
 

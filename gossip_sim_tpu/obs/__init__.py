@@ -1,0 +1,19 @@
+"""Runtime telemetry: span timers, counters, heartbeats, run reports.
+
+Importing this package stays JAX-free (bench.py's parent process keeps all
+JAX touches in subprocesses); the differential-timing helpers live in
+:mod:`gossip_sim_tpu.obs.difftime` and import JAX only when called.
+"""
+
+from .heartbeat import Heartbeat
+from .report import (PER_CHIP_TARGET, RUN_REPORT_SCHEMA, bench_summary,
+                     build_run_report, environment_info, validate_run_report,
+                     write_run_report)
+from .spans import SpanRegistry, get_registry, span
+
+__all__ = [
+    "Heartbeat", "SpanRegistry", "get_registry", "span",
+    "PER_CHIP_TARGET", "RUN_REPORT_SCHEMA", "bench_summary",
+    "build_run_report", "environment_info", "validate_run_report",
+    "write_run_report",
+]

@@ -1,0 +1,114 @@
+"""Differential scan timing: split JIT compile cost from steady-state
+per-round compute.
+
+A single wall-clock of a jitted ``k``-round scan conflates three costs:
+trace+compile, dispatch overhead, and ``k`` rounds of device compute.  The
+differential trick (productized out of tools/round_time.py): time a
+``k_small``-round call and a ``k_large``-round call (each separately
+compiled, each timed post-compile, best-of-``reps``), then
+
+    per_round = (t_large - t_small) / (k_large - k_small)
+
+cancels the fixed dispatch cost exactly and never trusts a first-call
+wall.  ``time_stage`` applies the same idea to an arbitrary stage function
+(productized out of tools/profile_v2.py): the stage is wrapped in an
+iteration-perturbed scan whose carry defeats CSE, so the compiler cannot
+hoist the stage out of the loop.
+
+JAX is imported inside the functions: importing :mod:`gossip_sim_tpu.obs`
+must never initialize an accelerator backend (bench.py's parent process
+keeps every JAX touch in subprocesses).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def best_of(fn, reps: int = 3) -> float:
+    """Minimum wall time of ``fn()`` over ``reps`` calls (noise floor)."""
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def differential_time(run_k, k_small: int = 1, k_large: int = 21,
+                      reps: int = 3) -> tuple:
+    """Differential per-iteration time of a compiled scan.
+
+    ``run_k(k)`` must execute a ``k``-iteration jitted scan and block until
+    the result is ready (each distinct ``k`` compiles its own program).
+    Returns ``(per_iter_s, t_small_s)`` where ``t_small_s`` is the
+    post-compile best-of wall of the ``k_small`` call — the fixed
+    dispatch+single-iteration cost callers print alongside the slope."""
+    if k_large <= k_small:
+        raise ValueError("k_large must exceed k_small")
+    run_k(k_small)                                # compile k_small program
+    t_small = best_of(lambda: run_k(k_small), reps)
+    run_k(k_large)                                # compile k_large program
+    t_large = best_of(lambda: run_k(k_large), reps)
+    return (t_large - t_small) / (k_large - k_small), t_small
+
+
+def make_round_scanner(params, tables, origins, state):
+    """``run_k(k)`` running ``k`` full gossip rounds from ``state``.
+
+    The returned callable jit-compiles one scan program per distinct ``k``
+    and returns an int reduced from the final state (forcing the device
+    computation, defeating dead-code elimination) — exactly the harness
+    tools/round_time.py used to hand-roll.  Feed it to
+    :func:`differential_time`."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..engine.core import round_step
+
+    @partial(jax.jit, static_argnums=(1,))
+    def _run_k(st, k):
+        def step(s, it):
+            s2, _ = round_step(params, tables, origins, s, it)
+            return s2, None
+        s, _ = lax.scan(step, st, jnp.arange(k))
+        return s.rc_upserts[0, 0] + s.active[0, 0, 0]
+
+    def run_k(k):
+        return int(_run_k(state, k))
+
+    return run_k
+
+
+def time_stage(make_fn, args, reps: int = 10, timing_reps: int = 2) -> float:
+    """Differential per-call time of one engine stage (seconds).
+
+    ``make_fn(*args, i)`` builds the stage computation; the extra trailing
+    iteration argument must perturb at least one input (``x + i * 0`` is
+    enough) so the scan carry feeds the stage and the compiler cannot hoist
+    it.  Each scan step reads one data-dependent element of the stage's
+    output into the carry, forcing full evaluation per iteration — the
+    harness tools/profile_v2.py used to copy-paste per stage."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @partial(jax.jit, static_argnums=(1,))
+    def run(args, k):
+        def body(c, i):
+            out = jnp.ravel(make_fn(*args, i + c))
+            pos = ((i * 1297 + c) % out.shape[0]).astype(jnp.int32)
+            return lax.dynamic_index_in_dim(
+                out, pos, keepdims=False).astype(jnp.int32), None
+        c, _ = lax.scan(body, jnp.int32(0), jnp.arange(k))
+        return c
+
+    per_call, _ = differential_time(lambda k: int(run(args, k)),
+                                    k_small=1, k_large=reps + 1,
+                                    reps=timing_reps)
+    return per_call

@@ -1,0 +1,66 @@
+"""Heartbeat logging with rate + ETA for long sweeps and batch loops.
+
+A sweep over hundreds of origins (or a thousand measured rounds) can run
+for minutes with no output between Influx drains; the heartbeat gives the
+operator a cheap periodic "N/M done, X/s, ETA H:MM:SS" line without any
+per-unit logging cost — ``beat()`` is a monotonic-clock compare unless the
+interval elapsed.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger("gossip_sim_tpu.obs")
+
+
+def _fmt_hms(seconds: float) -> str:
+    seconds = max(0, int(seconds))
+    h, rem = divmod(seconds, 3600)
+    m, s = divmod(rem, 60)
+    return f"{h}:{m:02d}:{s:02d}"
+
+
+class Heartbeat:
+    """Rate/ETA logger: ``beat(done)`` logs at most every ``interval_s``."""
+
+    def __init__(self, total_units: int, label: str = "progress",
+                 unit: str = "unit", interval_s: float = 30.0,
+                 logger: logging.Logger | None = None):
+        self.total = max(int(total_units), 0)
+        self.label = label
+        self.unit = unit
+        self.interval_s = interval_s
+        self.beats_logged = 0
+        self._log = logger if logger is not None else log
+        self._t0 = time.monotonic()
+        self._last = self._t0
+
+    def _format(self, done: int, now: float) -> str:
+        elapsed = now - self._t0
+        pct = 100.0 * done / self.total if self.total else 0.0
+        rate = done / elapsed if elapsed > 0 else 0.0
+        if rate > 0 and self.total:
+            eta = _fmt_hms((self.total - done) / rate)
+        else:
+            eta = "?"
+        return (f"HEARTBEAT {self.label}: {done}/{self.total} {self.unit}s "
+                f"({pct:.1f}%) | {rate:.2f} {self.unit}/s | "
+                f"elapsed {_fmt_hms(elapsed)} | ETA {eta}")
+
+    def beat(self, done_units: int, force: bool = False) -> str | None:
+        """Log progress if ``interval_s`` elapsed since the last beat (or
+        ``force``).  Returns the logged message, or None if suppressed."""
+        now = time.monotonic()
+        if not force and now - self._last < self.interval_s:
+            return None
+        msg = self._format(done_units, now)
+        self._log.info("%s", msg)
+        self._last = now
+        self.beats_logged += 1
+        return msg
+
+    def finish(self) -> str:
+        """Unconditional final beat at 100%% (end-of-loop summary)."""
+        return self.beat(self.total, force=True)

@@ -1,0 +1,234 @@
+"""Machine-readable run reports (``--run-report out.json``).
+
+One schema serves both the product CLI and bench.py so BENCH trajectory
+files and production runs are directly comparable: the report's top level
+carries exactly the flat keys bench.py has always emitted (``metric``,
+``value``, ``unit``, ``vs_baseline``, ``platform``, ``num_nodes``,
+``origin_batch``, ``iterations``, ``elapsed_s``, ``init_s``,
+``compile_s``, ``coverage_mean``, ``rmr_mean``) sourced from the shared
+span registry, plus nested sections the bench's one-liner omits:
+
+* ``config``       — the full simulation Config, JSON-safe
+* ``environment``  — python/jax versions, platform, device count, mesh
+* ``spans``        — every recorded span: ``{name: {total_s, count}}``
+* ``counters``     — raw counters (origin-iters, messages, ...)
+* ``throughput``   — origin-iters/s (steady), messages/s, end-to-end wall
+* ``faults``       — delivered/dropped/suppressed totals when impaired
+* ``influx``       — points sent / dropped / retries / final queue depth
+
+Span-name conventions (shared by cli.py, bench.py, tools/):
+
+* ``ingest``          account source -> {pubkey: stake}
+* ``engine/tables``   make_cluster_tables
+* ``engine/init``     init_state (first device allocation)
+* ``engine/compile``  the run's FIRST jitted rounds call (compile-
+                      dominated; the warm-up scan in the CLI, the timing
+                      warm-up in bench.py — same semantic as the
+                      historical ``compile_s``).  Recorded at most once
+                      per run: later warm-cache calls land in
+                      engine/warmup or engine/rounds
+* ``engine/warmup``   warm-up scans after the compile carrier (sims 2..N
+                      of a sweep re-running against the jit cache)
+* ``engine/rounds``   steady-state measured round blocks; the ONLY span
+                      feeding the throughput denominators
+* ``stats/harvest``   device->host transfer + stats-layer feeding
+* ``checkpoint/save`` checkpoint writes
+* ``influx/drain``    end-of-run reporter-thread drain
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import sys
+
+RUN_REPORT_SCHEMA = "gossip-sim-tpu/run-report/v1"
+
+# North-star per-chip throughput share (BASELINE.md): 10k nodes x all
+# origins x 1000 iters < 60 s on a v5e-8 == 166,667 origin-iters/s / 8.
+PER_CHIP_TARGET = 166_667.0 / 8
+
+#: top-level keys every report must carry, with accepted types
+REQUIRED_KEYS = {
+    "schema": str,
+    "metric": str,
+    "value": (int, float),
+    "unit": str,
+    "vs_baseline": (int, float),
+    "platform": str,
+    "num_nodes": int,
+    "origin_batch": int,
+    "iterations": int,
+    "elapsed_s": (int, float),
+    "init_s": (int, float),
+    "compile_s": (int, float),
+    "config": dict,
+    "environment": dict,
+    "spans": dict,
+    "counters": dict,
+    "throughput": dict,
+    "faults": dict,
+    "influx": dict,
+    "stats": dict,
+}
+
+
+def _jsonable(value):
+    """Best-effort JSON-safe conversion (enums/StepSize -> str)."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, enum.Enum):
+        return str(value)
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set)):
+        return [_jsonable(v) for v in value]
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return {f.name: _jsonable(getattr(value, f.name))
+                for f in dataclasses.fields(value)}
+    return str(value)
+
+
+def config_dict(config) -> dict:
+    """A Config dataclass as a JSON-safe dict."""
+    return _jsonable(config)
+
+
+def environment_info(platform: str = "", mesh_shape=None) -> dict:
+    """Python/JAX versions + device inventory.  JAX is imported lazily so
+    report assembly never forces accelerator init on its own; callers that
+    already initialized a backend pass ``platform`` through."""
+    env = {
+        "python": sys.version.split()[0],
+        "jax_version": None,
+        "platform": platform or "unknown",
+        "device_count": None,
+        "mesh_shape": list(mesh_shape) if mesh_shape else None,
+    }
+    backend_up = "jax" in sys.modules
+    try:
+        import jax
+        env["jax_version"] = jax.__version__
+        if backend_up and platform:
+            # backend already up (the caller measured on it): count is safe
+            env["device_count"] = len(jax.devices())
+    except Exception:  # pragma: no cover - report must never kill a run
+        pass
+    return env
+
+
+def _flat_summary(registry, *, platform: str, num_nodes: int,
+                  origin_batch: int, iterations: int) -> dict:
+    """The bench-compatible flat keys, sourced from the shared spans."""
+    init_s = registry.get("engine/init")
+    compile_s = registry.get("engine/compile")
+    elapsed_s = registry.get("engine/rounds")
+    origin_iters = registry.counter("origin_iters")
+    if not origin_iters:
+        origin_iters = origin_batch * iterations
+    value = origin_iters / elapsed_s if elapsed_s > 0 else 0.0
+    return {
+        "schema": RUN_REPORT_SCHEMA,
+        "metric": "origin_iters_per_sec",
+        "value": round(value, 2),
+        "unit": "origin*iters/s",
+        "vs_baseline": round(value / PER_CHIP_TARGET, 4),
+        "platform": platform,
+        "num_nodes": int(num_nodes),
+        "origin_batch": int(origin_batch),
+        "iterations": int(iterations),
+        "elapsed_s": round(elapsed_s, 3),
+        "init_s": round(init_s, 3),
+        "compile_s": round(compile_s, 3),
+    }
+
+
+def bench_summary(registry, *, platform: str, num_nodes: int,
+                  origin_batch: int, iterations: int,
+                  coverage_mean: float, rmr_mean: float) -> dict:
+    """bench.py's historical one-line JSON, sourced from the registry's
+    ``engine/init`` / ``engine/compile`` / ``engine/rounds`` spans."""
+    out = _flat_summary(registry, platform=platform, num_nodes=num_nodes,
+                        origin_batch=origin_batch, iterations=iterations)
+    del out["schema"]  # the bench line predates the report schema
+    out["coverage_mean"] = round(coverage_mean, 6)
+    out["rmr_mean"] = round(rmr_mean, 6)
+    return out
+
+
+def build_run_report(config, registry, *, stats: dict | None = None,
+                     influx: dict | None = None,
+                     faults: dict | None = None) -> dict:
+    """Assemble the full run report from the span registry + run results.
+
+    ``stats``/``influx``/``faults`` are optional summary dicts the caller
+    fills from the stats layer and the Influx sender; absent sections are
+    emitted as ``{}`` so the schema stays fixed."""
+    snap = registry.snapshot()
+    info = snap["info"]
+    platform = str(info.get("platform", "unknown"))
+    num_nodes = int(info.get("num_nodes", 0))
+    origin_batch = int(info.get("origin_batch", 1))
+    iterations = int(getattr(config, "gossip_iterations", 0))
+
+    report = _flat_summary(registry, platform=platform, num_nodes=num_nodes,
+                           origin_batch=origin_batch, iterations=iterations)
+    rounds_s = registry.get("engine/rounds")
+    msgs = registry.counter("messages_delivered")
+    wall = snap["wall_s"]
+    report.update({
+        "coverage_mean": float((stats or {}).get("coverage_mean", 0.0)),
+        "rmr_mean": float((stats or {}).get("rmr_mean", 0.0)),
+        "config": config_dict(config),
+        "environment": environment_info(
+            platform=platform, mesh_shape=info.get("mesh_shape")),
+        "spans": snap["spans"],
+        "counters": snap["counters"],
+        "throughput": {
+            "origin_iters_per_sec": report["value"],
+            "messages_per_sec": round(msgs / rounds_s, 2) if rounds_s > 0
+            else 0.0,
+            "wall_s": round(wall, 3),
+        },
+        "faults": dict(faults or {}),
+        "influx": dict(influx or {}),
+        "stats": dict(stats or {}),
+    })
+    return report
+
+
+def write_run_report(path: str, report: dict) -> None:
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def validate_run_report(report: dict) -> list:
+    """Schema check: returns a list of problems (empty == valid)."""
+    problems = []
+    if not isinstance(report, dict):
+        return [f"report is {type(report).__name__}, not dict"]
+    for key, types in REQUIRED_KEYS.items():
+        if key not in report:
+            problems.append(f"missing key: {key}")
+        elif not isinstance(report[key], types):
+            problems.append(
+                f"key {key}: expected {types}, got "
+                f"{type(report[key]).__name__}")
+    if report.get("schema") not in (None, RUN_REPORT_SCHEMA):
+        problems.append(f"unknown schema: {report.get('schema')!r}")
+    for name, ent in (report.get("spans") or {}).items():
+        if (not isinstance(ent, dict) or "total_s" not in ent
+                or "count" not in ent):
+            problems.append(f"span {name}: needs total_s + count")
+    thr = report.get("throughput")
+    if isinstance(thr, dict):
+        for k in ("origin_iters_per_sec", "messages_per_sec", "wall_s"):
+            if not isinstance(thr.get(k), (int, float)):
+                problems.append(f"throughput.{k} missing or non-numeric")
+    try:
+        json.dumps(report)
+    except (TypeError, ValueError) as e:
+        problems.append(f"not JSON-serializable: {e}")
+    return problems

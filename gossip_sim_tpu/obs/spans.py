@@ -1,0 +1,170 @@
+"""Hierarchical span timers + counters: the host-side telemetry core.
+
+Design constraints (ISSUE 2):
+
+* **low overhead** — one ``time.perf_counter()`` pair and one locked dict
+  update per span exit (~1-2 us); cheap enough to leave on in production
+  paths, and a ``enabled=False`` registry short-circuits to a shared no-op
+  context manager for the zero-cost path.
+* **nestable** — spans are reentrant; a span opened inside another span
+  (same thread, same or different name) records its own wall time
+  independently.  Hierarchy is expressed through slash-separated names
+  (``engine/rounds``, ``stats/harvest``), the same convention XProf uses
+  for ``jax.named_scope`` stages, so host spans and device traces line up.
+* **thread-safe** — the Influx sender thread and heartbeat callers may
+  record concurrently with the simulation thread; the active-span stack is
+  thread-local and all aggregate updates take the registry lock.
+
+The module-level default registry is what the CLI, bench.py and the tools
+share (one process == one run); tests construct private registries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class _NullSpan:
+    """Shared no-op context manager for disabled registries."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class SpanRegistry:
+    """Aggregating span-timer + counter + run-metadata registry."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._spans: dict[str, list] = {}     # name -> [total_s, count]
+        self._counters: dict[str, float] = {}
+        self._info: dict[str, object] = {}
+        self._start = time.perf_counter()
+
+    # -- spans ------------------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextmanager
+    def _span_cm(self, name: str):
+        stack = self._stack()
+        stack.append(name)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            stack.pop()
+            with self._lock:
+                ent = self._spans.get(name)
+                if ent is None:
+                    self._spans[name] = [dt, 1]
+                else:
+                    ent[0] += dt
+                    ent[1] += 1
+
+    def span(self, name: str):
+        """Context manager timing a named span (reentrant, thread-safe)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return self._span_cm(name)
+
+    def record(self, name: str, seconds: float, count: int = 1) -> None:
+        """Record an externally-measured duration under ``name`` (e.g. a
+        differentially-derived compile time, obs/difftime.py)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self._spans.get(name)
+            if ent is None:
+                self._spans[name] = [float(seconds), count]
+            else:
+                ent[0] += float(seconds)
+                ent[1] += count
+
+    def get(self, name: str) -> float:
+        """Total seconds recorded under ``name`` (0.0 if never entered)."""
+        with self._lock:
+            ent = self._spans.get(name)
+            return ent[0] if ent else 0.0
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            ent = self._spans.get(name)
+            return ent[1] if ent else 0
+
+    def active_depth(self) -> int:
+        """Current nesting depth on the calling thread (diagnostics)."""
+        return len(self._stack())
+
+    # -- counters ---------------------------------------------------------
+
+    def add(self, name: str, value: float = 1) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    # -- run metadata -----------------------------------------------------
+
+    def set_info(self, key: str, value) -> None:
+        with self._lock:
+            self._info[key] = value
+
+    def info(self, key: str, default=None):
+        with self._lock:
+            return self._info.get(key, default)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy: ``{"spans": {name: {total_s, count}},
+        "counters": {...}, "info": {...}, "wall_s": ...}``."""
+        with self._lock:
+            return {
+                "spans": {k: {"total_s": v[0], "count": v[1]}
+                          for k, v in sorted(self._spans.items())},
+                "counters": dict(sorted(self._counters.items())),
+                "info": dict(self._info),
+                "wall_s": time.perf_counter() - self._start,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._counters.clear()
+            self._info.clear()
+            self._start = time.perf_counter()
+
+
+_DEFAULT = SpanRegistry()
+
+
+def get_registry() -> SpanRegistry:
+    """The process-wide default registry (one process == one run)."""
+    return _DEFAULT
+
+
+def span(name: str):
+    """``with obs.span("engine/rounds"): ...`` on the default registry."""
+    return _DEFAULT.span(name)

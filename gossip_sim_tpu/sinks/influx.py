@@ -14,6 +14,9 @@ Series and field names are the compatibility contract
 ``simulation_config``, ``validator_stake_distribution``, ``config``,
 ``stranded_node_iterations``, ``stranded_node_histogram``,
 ``aggregate_hops_histogram``, ``{egress,ingress,prune}_message_count``.
+Extensions beyond the reference: ``delivery`` / ``coverage_recovery``
+(fault injection, faults.py) and ``sim_perf`` (runtime telemetry, obs/:
+round-block wall time, throughput, sender queue depth).
 """
 
 from __future__ import annotations
@@ -268,6 +271,19 @@ class InfluxDataPoint:
             f"max_iters={max_iters},unrecovered={unrecovered} ")
         self.append_timestamp()
 
+    def create_sim_perf_point(self, round_wall_s, origin_iters_per_sec,
+                              queue_depth, iters):
+        """Runtime-telemetry series (obs/): wall time and throughput of one
+        measured round block plus the sender queue depth at emission time —
+        the live "is the sim keeping up / is the sink backed up" signal."""
+        self.datapoint += (
+            f"sim_perf,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"round_wall_s={round_wall_s},"
+            f"origin_iters_per_sec={origin_iters_per_sec},"
+            f"queue_depth={queue_depth},iters={iters} ")
+        self.append_timestamp()
+
     def create_messages_point(self, messages_direction: str, messages,
                               simulation_iter_val: int):
         for bucket, count in messages.items():
@@ -296,12 +312,23 @@ class InfluxDB:
         self.retry_base = retry_base
         self.max_queue = max_queue
         self.dropped_points = 0   # points lost after retries / queue overflow
+        self.points_sent = 0      # points acknowledged 2xx by the endpoint
+        self.retry_count = 0      # transient-failure retries attempted
         self._send_q = None
         self._send_lock = threading.Lock()
 
     def _count_dropped(self):
         with self._send_lock:
             self.dropped_points += 1
+
+    def sender_stats(self) -> dict:
+        """Delivery accounting for end-of-run logging and the run report."""
+        with self._send_lock:
+            return {
+                "points_sent": self.points_sent,
+                "dropped_points": self.dropped_points,
+                "retries": self.retry_count,
+            }
 
     def _post(self, body: str):
         """POST one line-protocol body; retry transient failures with
@@ -325,6 +352,8 @@ class InfluxDB:
                     with urllib.request.urlopen(
                             req, timeout=self.timeout) as resp:
                         if 200 <= resp.status < 300:
+                            with self._send_lock:
+                                self.points_sent += 1
                             return
                         err = f"HTTP status {resp.status}"
                 except urllib.error.HTTPError as exc:
@@ -336,6 +365,8 @@ class InfluxDB:
                 except (urllib.error.URLError, OSError) as exc:
                     err = exc
                 if retryable and attempt < self.max_retries:
+                    with self._send_lock:
+                        self.retry_count += 1
                     log.warning("InfluxDB send failed (attempt %s/%s): %s — "
                                 "retrying in %.2fs", attempt + 1,
                                 self.max_retries + 1, err, delay)
@@ -386,48 +417,75 @@ class InfluxDB:
 
 
 class InfluxThread:
-    """Reporter loop (influx_db.rs:146-204)."""
+    """Reporter loop (influx_db.rs:146-204).
 
-    @staticmethod
-    def start(endpoint: str, username: str, password: str, database: str,
-              datapoint_queue: DatapointQueue):
-        tracker = Tracker()
-        influx_db = InfluxDB(endpoint, username, password, database, tracker)
+    Instances are join-able handles that keep the underlying ``InfluxDB``
+    reachable after the drain, so end-of-run logging and the run report
+    (obs/report.py) can surface dropped-point / retry accounting instead of
+    burying it in the drain log."""
+
+    def __init__(self, endpoint: str, username: str, password: str,
+                 database: str, datapoint_queue: DatapointQueue):
+        self.tracker = Tracker()
+        self.db = InfluxDB(endpoint, username, password, database,
+                           self.tracker)
+        self._queue = datapoint_queue
+        self._thread: threading.Thread | None = None
+
+    def run(self):
+        """The reporter loop body (blocks until the end sentinel drains)."""
         wait_time = 0.1
         rx_last_datapoint = False
         draining_logged = False
         while True:
-            dp = datapoint_queue.pop_front()
+            dp = self._queue.pop_front()
             if dp is not None:
                 if dp.last_datapoint():
                     rx_last_datapoint = True
                 elif dp.is_start():
                     wait_time = 0.001
                 else:
-                    influx_db.send_data_points(dp)
-                    tracker.add_dequeued()
+                    self.db.send_data_points(dp)
+                    self.tracker.add_dequeued()
             if rx_last_datapoint:
                 if not draining_logged:
                     draining_logged = True
                     log.info("Last simulation datapoint recorded. "
                              "Draining Queue...")
-                if tracker.equal():
-                    if influx_db.dropped_points:
+                if self.tracker.equal():
+                    if self.db.dropped_points:
                         log.warning("WARNING: %s InfluxDB point(s) dropped "
                                     "(send failures after retries or queue "
-                                    "overflow)", influx_db.dropped_points)
+                                    "overflow)", self.db.dropped_points)
                     log.info("Queue Drained. Exiting...")
                     break
             time.sleep(wait_time)
 
+    def join(self, timeout: float | None = None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def sender_stats(self) -> dict:
+        """points_sent / dropped_points / retries (InfluxDB.sender_stats)."""
+        return self.db.sender_stats()
+
+    @staticmethod
+    def start(endpoint: str, username: str, password: str, database: str,
+              datapoint_queue: DatapointQueue):
+        """Run the reporter loop inline (the reference's thread body)."""
+        InfluxThread(endpoint, username, password, database,
+                     datapoint_queue).run()
+
     @staticmethod
     def spawn(endpoint: str, username: str, password: str, database: str,
-              datapoint_queue: DatapointQueue) -> threading.Thread:
-        """Convenience: run ``start`` in a daemon thread and return it
+              datapoint_queue: DatapointQueue) -> "InfluxThread":
+        """Run the loop in a daemon thread; returns the join-able handle
         (the reference's std::thread::spawn, gossip_main.rs:746-768)."""
-        t = threading.Thread(
-            target=InfluxThread.start,
-            args=(endpoint, username, password, database, datapoint_queue),
-            daemon=True)
-        t.start()
-        return t
+        it = InfluxThread(endpoint, username, password, database,
+                          datapoint_queue)
+        it._thread = threading.Thread(target=it.run, daemon=True)
+        it._thread.start()
+        return it

@@ -1,0 +1,349 @@
+"""Observability subsystem tests (gossip_sim_tpu/obs/): span-timer
+nesting/overhead, run-report schema, heartbeat/ETA output, the sim_perf
+Influx series, and the sender-stats surfacing (ISSUE 2)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from gossip_sim_tpu.config import Config
+from gossip_sim_tpu.obs import (Heartbeat, SpanRegistry, bench_summary,
+                                build_run_report, validate_run_report)
+from gossip_sim_tpu.obs.report import REQUIRED_KEYS, RUN_REPORT_SCHEMA
+from gossip_sim_tpu.sinks import InfluxDataPoint
+
+
+# --------------------------------------------------------------------------
+# span timers
+# --------------------------------------------------------------------------
+
+def test_span_nesting_records_both_levels():
+    reg = SpanRegistry()
+    with reg.span("outer"):
+        time.sleep(0.01)
+        with reg.span("inner"):
+            time.sleep(0.01)
+        assert reg.active_depth() == 1
+    assert reg.active_depth() == 0
+    assert reg.get("outer") >= reg.get("inner") > 0.0
+    assert reg.count("outer") == reg.count("inner") == 1
+
+
+def test_span_reentrant_same_name():
+    reg = SpanRegistry()
+    with reg.span("a"):
+        with reg.span("a"):
+            pass
+    assert reg.count("a") == 2
+
+
+def test_span_accumulates_and_manual_record():
+    reg = SpanRegistry()
+    for _ in range(3):
+        with reg.span("s"):
+            pass
+    assert reg.count("s") == 3
+    reg.record("derived", 1.5, count=10)
+    assert reg.get("derived") == pytest.approx(1.5)
+    assert reg.count("derived") == 10
+
+
+def test_counters_info_snapshot_reset():
+    reg = SpanRegistry()
+    reg.add("origin_iters", 5)
+    reg.add("origin_iters", 7)
+    reg.set_info("num_nodes", 42)
+    with reg.span("x"):
+        pass
+    snap = reg.snapshot()
+    assert snap["counters"]["origin_iters"] == 12
+    assert snap["info"]["num_nodes"] == 42
+    assert snap["spans"]["x"]["count"] == 1
+    assert snap["wall_s"] > 0
+    reg.reset()
+    assert reg.counter("origin_iters") == 0
+    assert reg.get("x") == 0.0
+    assert reg.info("num_nodes") is None
+
+
+def test_span_thread_safety():
+    reg = SpanRegistry()
+    n_threads, per_thread = 8, 200
+
+    def work():
+        for _ in range(per_thread):
+            with reg.span("shared"):
+                reg.add("hits")
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert reg.count("shared") == n_threads * per_thread
+    assert reg.counter("hits") == n_threads * per_thread
+
+
+def test_span_overhead_is_low():
+    """The whole point is "cheap enough to leave on": < 50 us per span
+    enabled (measured ~1-2 us), and near-free when disabled."""
+    reg = SpanRegistry()
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with reg.span("hot"):
+            pass
+    per_span = (time.perf_counter() - t0) / n
+    assert per_span < 50e-6, f"span overhead {per_span*1e6:.1f} us/span"
+
+    off = SpanRegistry(enabled=False)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with off.span("hot"):
+            pass
+    per_off = (time.perf_counter() - t0) / n
+    assert per_off < 10e-6
+    assert off.get("hot") == 0.0
+
+
+# --------------------------------------------------------------------------
+# run report
+# --------------------------------------------------------------------------
+
+def _fake_registry():
+    reg = SpanRegistry()
+    reg.record("ingest", 0.01)
+    reg.record("engine/tables", 0.002)
+    reg.record("engine/init", 0.5)
+    reg.record("engine/compile", 2.0)
+    reg.record("engine/rounds", 4.0, count=3)
+    reg.record("stats/harvest", 0.1, count=3)
+    reg.add("origin_iters", 800)
+    reg.add("messages_delivered", 120_000)
+    reg.set_info("platform", "cpu")
+    reg.set_info("num_nodes", 1000)
+    reg.set_info("origin_batch", 8)
+    return reg
+
+
+def test_run_report_schema_golden_keys():
+    cfg = Config(gossip_iterations=100, num_synthetic_nodes=1000)
+    report = build_run_report(
+        cfg, _fake_registry(),
+        stats={"coverage_mean": 0.99, "rmr_mean": 5.2},
+        influx={"points_sent": 10, "dropped_points": 1, "retries": 2},
+        faults={"delivered": 100, "dropped": 3, "suppressed": 0})
+    assert validate_run_report(report) == []
+    # golden top-level keys: the schema contract
+    for key in REQUIRED_KEYS:
+        assert key in report, f"missing {key}"
+    assert report["schema"] == RUN_REPORT_SCHEMA
+    # bench.py-compatible flat fields sourced from the spans
+    assert report["init_s"] == pytest.approx(0.5)
+    assert report["compile_s"] == pytest.approx(2.0)
+    assert report["elapsed_s"] == pytest.approx(4.0)
+    assert report["value"] == pytest.approx(800 / 4.0)
+    assert report["num_nodes"] == 1000
+    assert report["origin_batch"] == 8
+    assert report["platform"] == "cpu"
+    assert report["coverage_mean"] == pytest.approx(0.99)
+    # nested sections
+    assert report["throughput"]["messages_per_sec"] == pytest.approx(30000.0)
+    assert report["spans"]["engine/rounds"]["count"] == 3
+    assert report["influx"]["dropped_points"] == 1
+    assert report["faults"]["dropped"] == 3
+    assert report["config"]["gossip_iterations"] == 100
+    assert report["environment"]["python"]
+    # the whole thing must round-trip through JSON
+    assert json.loads(json.dumps(report)) == report
+
+
+def test_validate_run_report_catches_problems():
+    cfg = Config()
+    report = build_run_report(cfg, _fake_registry())
+    assert validate_run_report(report) == []
+    bad = dict(report)
+    del bad["spans"]
+    assert any("spans" in p for p in validate_run_report(bad))
+    bad = dict(report)
+    bad["value"] = "fast"
+    assert any("value" in p for p in validate_run_report(bad))
+    bad = dict(report)
+    bad["spans"] = {"x": {"total_s": 1.0}}  # no count
+    assert any("x" in p for p in validate_run_report(bad))
+    assert validate_run_report([]) != []
+
+
+def test_bench_summary_matches_historical_bench_keys():
+    """BENCH trajectory compatibility: bench.py's line keeps its exact
+    key set, now sourced from the shared spans."""
+    out = bench_summary(_fake_registry(), platform="cpu", num_nodes=1000,
+                        origin_batch=8, iterations=100,
+                        coverage_mean=0.994, rmr_mean=5.2)
+    assert set(out) == {"metric", "value", "unit", "vs_baseline", "platform",
+                        "num_nodes", "origin_batch", "iterations",
+                        "elapsed_s", "init_s", "compile_s", "coverage_mean",
+                        "rmr_mean"}
+    assert out["value"] == pytest.approx(800 / 4.0)
+    assert out["compile_s"] == pytest.approx(2.0)
+
+
+# --------------------------------------------------------------------------
+# heartbeat / ETA
+# --------------------------------------------------------------------------
+
+def test_heartbeat_logs_rate_and_eta(caplog):
+    hb = Heartbeat(100, label="sweep", unit="sim", interval_s=0.0)
+    time.sleep(0.01)
+    msg = hb.beat(25)
+    assert msg is not None
+    assert "HEARTBEAT sweep: 25/100" in msg
+    assert "(25.0%)" in msg
+    assert "ETA" in msg and "?" not in msg.split("ETA")[1]
+    assert hb.beats_logged == 1
+    final = hb.finish()
+    assert "100/100" in final and "(100.0%)" in final
+
+
+def test_heartbeat_respects_interval():
+    hb = Heartbeat(10, interval_s=3600.0)
+    assert hb.beat(1) is None          # interval not elapsed
+    assert hb.beats_logged == 0
+    assert hb.beat(2, force=True) is not None
+    assert hb.finish() is not None     # finish always logs
+
+
+def test_heartbeat_zero_progress_eta_unknown():
+    hb = Heartbeat(10, interval_s=0.0)
+    msg = hb.beat(0)
+    assert "ETA ?" in msg
+
+
+# --------------------------------------------------------------------------
+# sim_perf series + sender stats
+# --------------------------------------------------------------------------
+
+def test_sim_perf_line_protocol():
+    dp = InfluxDataPoint("99", 2)
+    dp.create_sim_perf_point(0.251, 1020.5, 7, 256)
+    assert dp.data().startswith(
+        "sim_perf,simulation_iter=2,start_time=99 "
+        "round_wall_s=0.251,origin_iters_per_sec=1020.5,"
+        "queue_depth=7,iters=256 ")
+    assert dp.data().endswith("\n")
+
+
+def test_influx_thread_exposes_sender_stats_after_drain():
+    from gossip_sim_tpu.sinks import DatapointQueue, InfluxThread
+
+    q = DatapointQueue()
+    dp = InfluxDataPoint("1", 0)
+    dp.create_data_point(1.0, "coverage")
+    q.push_back(dp)
+    end = InfluxDataPoint()
+    end.set_last_datapoint()
+    q.push_back(end)
+    t = InfluxThread.spawn("http://127.0.0.1:9", "u", "p", "db", q)
+    t.join(timeout=30)
+    assert not t.is_alive()
+    stats = t.sender_stats()
+    assert stats["dropped_points"] == 1
+    assert stats["points_sent"] == 0
+    assert stats["retries"] >= 1
+    assert set(stats) == {"points_sent", "dropped_points", "retries"}
+
+
+# --------------------------------------------------------------------------
+# XProf stage annotations
+# --------------------------------------------------------------------------
+
+def test_round_step_named_scopes_reach_compiled_hlo():
+    """The round/* named scopes must survive into compiled-HLO op metadata
+    — that is what XProf/TensorBoard groups device time by.  With default
+    (all-off) impairment knobs the fault scopes are python-gated out."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                       make_cluster_tables)
+    from gossip_sim_tpu.engine.core import round_step
+
+    stakes = (np.arange(1, 21) * 10**9).astype(np.int64)
+    tables = make_cluster_tables(stakes)
+    params = EngineParams(num_nodes=20, warm_up_rounds=0)
+    origins = jnp.arange(1, dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+    comp = jax.jit(
+        lambda st: round_step(params, tables, origins, st, jnp.int32(0))
+    ).lower(state).compile()
+    hlo = comp.as_text()
+    for scope in ("round/verb1_push_targets", "round/bfs_propagate",
+                  "round/verb2_consume", "round/rc_merge",
+                  "round/verb3_prune_decide", "round/verb4_prune_apply",
+                  "round/verb5_rotate", "round/round_stats"):
+        assert scope in hlo, f"named scope {scope} missing from HLO"
+
+
+# --------------------------------------------------------------------------
+# CLI integration: flags + end-to-end run report
+# --------------------------------------------------------------------------
+
+def test_profile_dir_flag_and_alias():
+    from gossip_sim_tpu.cli import build_parser, config_from_args
+
+    cfg = config_from_args(build_parser().parse_args(
+        ["--profile-dir", "/tmp/x"]))
+    assert cfg.jax_profile_dir == "/tmp/x"
+    cfg = config_from_args(build_parser().parse_args(
+        ["--jax-profile", "/tmp/y"]))  # historical alias still accepted
+    assert cfg.jax_profile_dir == "/tmp/y"
+    cfg = config_from_args(build_parser().parse_args(
+        ["--run-report", "/tmp/r.json"]))
+    assert cfg.run_report_path == "/tmp/r.json"
+
+
+def test_cli_run_report_end_to_end_tpu(tmp_path):
+    """Acceptance: a default CPU run with --run-report emits schema-valid
+    JSON with nonzero compile/round/stats spans and throughput."""
+    from gossip_sim_tpu.cli import main
+
+    path = str(tmp_path / "report.json")
+    rc = main(["--num-synthetic-nodes", "30", "--iterations", "10",
+               "--warm-up-rounds", "4", "--seed", "7",
+               "--run-report", path])
+    assert rc == 0
+    with open(path) as f:
+        report = json.load(f)
+    assert validate_run_report(report) == []
+    assert report["num_nodes"] == 30
+    assert report["origin_batch"] == 1
+    assert report["spans"]["engine/compile"]["total_s"] > 0
+    assert report["spans"]["engine/rounds"]["total_s"] > 0
+    assert report["spans"]["stats/harvest"]["total_s"] > 0
+    assert report["spans"]["engine/init"]["total_s"] > 0
+    assert report["throughput"]["origin_iters_per_sec"] > 0
+    assert report["counters"]["origin_iters"] == 6
+    assert 0.0 < report["coverage_mean"] <= 1.0
+    assert report["config"]["num_synthetic_nodes"] == 30
+    assert report["environment"]["jax_version"]
+
+
+def test_cli_run_report_oracle_backend(tmp_path):
+    from gossip_sim_tpu.cli import main
+
+    path = str(tmp_path / "report.json")
+    rc = main(["--num-synthetic-nodes", "20", "--iterations", "6",
+               "--warm-up-rounds", "2", "--seed", "3", "--backend", "oracle",
+               "--run-report", path])
+    assert rc == 0
+    with open(path) as f:
+        report = json.load(f)
+    assert validate_run_report(report) == []
+    assert report["platform"] == "oracle"
+    assert report["spans"]["engine/rounds"]["total_s"] > 0
+    assert report["spans"]["stats/harvest"]["total_s"] > 0
+    assert report["counters"]["origin_iters"] == 4
+    assert report["value"] > 0

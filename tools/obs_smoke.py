@@ -1,0 +1,136 @@
+"""Observability smoke gate: tiny CPU sim with ``--run-report``, schema
+validation, and a telemetry-overhead budget.
+
+Fast CI gate (CPU, well under 60 s):
+
+  1. one cold run to populate the in-process jit cache (untimed),
+  2. best-of-N timed runs with no obs flags,
+  3. best-of-N timed runs with ``--run-report`` on,
+  4. assertions: every run exits 0, the report validates against the
+     obs/report.py schema with nonzero compile/round/stats spans and
+     throughput, coverage is sane, the telemetry overhead is under
+     ``--overhead-budget`` (default 2%) plus a small absolute slack that
+     absorbs CI timer noise on sub-second runs, and two reported runs are
+     deterministic (identical coverage/rmr under the fixed seed).
+
+Usage: python tools/obs_smoke.py [--num-nodes 40] [--iterations 16]
+       [--warm-up-rounds 4] [--seed 7] [--reps 2]
+       [--overhead-budget 0.02] [--overhead-slack-s 0.2]
+
+Exit code 0 = all assertions hold; 1 = an observability invariant failed.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="run-report schema + telemetry-overhead smoke "
+                    "(CPU, <60s)")
+    ap.add_argument("--num-nodes", type=int, default=40)
+    ap.add_argument("--iterations", type=int, default=16)
+    ap.add_argument("--warm-up-rounds", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--reps", type=int, default=2,
+                    help="timed repetitions per arm (best-of)")
+    ap.add_argument("--overhead-budget", type=float, default=0.02,
+                    help="max fractional telemetry overhead (default 2%%)")
+    ap.add_argument("--overhead-slack-s", type=float, default=0.2,
+                    help="absolute slack absorbing timer noise on "
+                         "sub-second runs")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from gossip_sim_tpu.cli import main as cli_main
+    from gossip_sim_tpu.obs import validate_run_report
+
+    base = ["--num-synthetic-nodes", str(args.num_nodes),
+            "--iterations", str(args.iterations),
+            "--warm-up-rounds", str(args.warm_up_rounds),
+            "--seed", str(args.seed)]
+
+    def timed_run(extra):
+        t0 = time.perf_counter()
+        rc = cli_main(base + extra)
+        return rc, time.perf_counter() - t0
+
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    t_start = time.time()
+    print(f"obs smoke: n={args.num_nodes} iters={args.iterations} "
+          f"warmup={args.warm_up_rounds} reps={args.reps}")
+
+    # 1. cold run: compile once so both timed arms run against a warm cache
+    rc, t_cold = timed_run([])
+    check(rc == 0, f"cold run exits 0 (took {t_cold:.2f}s)")
+
+    # 2. timed plain arm (no obs flags)
+    t_plain = min(timed_run([])[1] for _ in range(max(1, args.reps)))
+
+    # 3. timed telemetry arm (+ determinism pair)
+    reports, t_obs = [], float("inf")
+    for i in range(max(2, args.reps)):
+        path = f"/tmp/obs_smoke_report_{os.getpid()}_{i}.json"
+        rc, dt = timed_run(["--run-report", path])
+        t_obs = min(t_obs, dt)
+        check(rc == 0, f"telemetry run {i} exits 0")
+        try:
+            with open(path) as f:
+                reports.append(json.load(f))
+            os.unlink(path)
+        except (OSError, ValueError) as e:
+            check(False, f"report {i} unreadable: {e}")
+
+    # 4. schema + content
+    for i, rep in enumerate(reports):
+        problems = validate_run_report(rep)
+        check(problems == [], f"report {i} schema-valid {problems or ''}")
+    if reports:
+        rep = reports[0]
+        spans = rep.get("spans", {})
+        for name in ("engine/compile", "engine/rounds", "stats/harvest",
+                     "engine/init", "ingest"):
+            check(spans.get(name, {}).get("total_s", 0) > 0,
+                  f"span {name} nonzero")
+        check(rep.get("throughput", {}).get("origin_iters_per_sec", 0) > 0,
+              "throughput origin_iters_per_sec nonzero")
+        check(0.0 < rep.get("coverage_mean", 0) <= 1.0,
+              f"coverage_mean sane ({rep.get('coverage_mean')})")
+        check(rep.get("num_nodes") == args.num_nodes,
+              "num_nodes matches the cluster")
+    if len(reports) >= 2:
+        same = all(reports[0][k] == r[k]
+                   for r in reports[1:] for k in ("coverage_mean", "rmr_mean"))
+        check(same, "reported stats deterministic under the fixed seed")
+
+    # 5. overhead budget
+    budget = t_plain * (1.0 + args.overhead_budget) + args.overhead_slack_s
+    overhead = (t_obs - t_plain) / t_plain if t_plain > 0 else 0.0
+    print(f"  plain={t_plain:.3f}s telemetry={t_obs:.3f}s "
+          f"overhead={overhead * 100:+.2f}%")
+    check(t_obs <= budget,
+          f"telemetry overhead within {args.overhead_budget:.0%} "
+          f"(+{args.overhead_slack_s}s slack)")
+
+    print(f"  elapsed: {time.time() - t_start:.1f}s")
+    if failures:
+        print(f"OBS SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("OBS SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

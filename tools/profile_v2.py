@@ -1,9 +1,13 @@
-"""Stage-level differential profiling of the v2 round at target shapes."""
-import os, sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+"""Stage-level differential profiling of the v2 round at target shapes.
 
-import time
-from functools import partial
+The scan harness + differential timing live in
+gossip_sim_tpu/obs/difftime.py (time_stage); this file only defines the
+stage computations and the shapes.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -12,6 +16,7 @@ from jax import lax
 
 from gossip_sim_tpu.engine import EngineParams, init_state, make_cluster_tables
 from gossip_sim_tpu.engine import core as C
+from gossip_sim_tpu.obs.difftime import time_stage
 
 REPS = 10
 
@@ -33,27 +38,10 @@ NF, NK, NS = N * F, N * K, N * S
 
 def bench(name, make_fn, *args):
     try:
-        @partial(jax.jit, static_argnums=(1,))
-        def run(args, k):
-            def body(c, i):
-                out = jnp.ravel(make_fn(*args, i + c))
-                pos = ((i * 1297 + c) % out.shape[0]).astype(jnp.int32)
-                return lax.dynamic_index_in_dim(
-                    out, pos, keepdims=False).astype(jnp.int32), None
-            c, _ = lax.scan(body, jnp.int32(0), jnp.arange(k))
-            return c
-        int(run(args, 1)); int(run(args, REPS + 1))
-        t1 = min(_t(run, args, 1) for _ in range(2))
-        t2 = min(_t(run, args, REPS + 1) for _ in range(2))
-        print(f"{name:46s} {(t2-t1)/REPS*1e3:9.3f} ms")
+        per_call = time_stage(make_fn, args, reps=REPS, timing_reps=2)
+        print(f"{name:46s} {per_call*1e3:9.3f} ms")
     except Exception as e:
         print(f"{name:46s} FAILED: {type(e).__name__} {str(e)[:90]}")
-
-
-def _t(run, args, k):
-    t0 = time.time()
-    int(run(args, k))
-    return time.time() - t0
 
 
 peer = state.active

@@ -1,9 +1,16 @@
-"""Trustworthy per-round compute timing via differential scan lengths."""
-import os, sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+"""Trustworthy per-round compute timing via differential scan lengths.
 
+Thin CLI over the productized helpers in gossip_sim_tpu/obs/difftime.py
+(the scan harness + differential timing used to live here, copy-pasted):
+times a 1-round and a 21-round jitted scan and reports the slope as the
+per-round cost, immune to dispatch overhead and first-call compile walls.
+
+Usage: python tools/round_time.py [N] [O]
+"""
+import os
 import sys
-import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -11,9 +18,7 @@ import numpy as np
 
 from gossip_sim_tpu.engine import (EngineParams, init_state,
                                    make_cluster_tables)
-from gossip_sim_tpu.engine.core import round_step
-from jax import lax
-from functools import partial
+from gossip_sim_tpu.obs.difftime import differential_time, make_round_scanner
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 2000
 O = int(sys.argv[2]) if len(sys.argv) > 2 else 8
@@ -25,29 +30,9 @@ params = EngineParams(num_nodes=N, warm_up_rounds=0)
 origins = jnp.arange(O, dtype=jnp.int32)
 state = init_state(jax.random.PRNGKey(0), tables, origins, params)
 
-
-@partial(jax.jit, static_argnums=(1,))
-def run_k(state, k):
-    def step(st, it):
-        st2, rows = round_step(params, tables, origins, st, it)
-        return st2, None
-    st, _ = lax.scan(step, state, jnp.arange(k))
-    return st.rc_upserts[0, 0] + st.active[0, 0, 0]
-
-
-def timed(k, reps=3):
-    int(run_k(state, k))  # compile
-    best = 1e9
-    for _ in range(reps):
-        t0 = time.time()
-        int(run_k(state, k))
-        best = min(best, time.time() - t0)
-    return best
-
-
-t1 = timed(1)
-t21 = timed(21)
-per_round = (t21 - t1) / 20
+run_k = make_round_scanner(params, tables, origins, state)
+per_round, t1 = differential_time(run_k, k_small=1, k_large=21, reps=3)
+t21 = t1 + 20 * per_round
 print(f"N={N} O={O}: 1-round call {t1*1e3:.1f} ms, 21-round call "
       f"{t21*1e3:.1f} ms -> per-round {per_round*1e3:.2f} ms, "
       f"{O/per_round:.1f} origin-iters/s")
