@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 import time
 
@@ -92,6 +93,42 @@ def _impair_params(config) -> dict:
                 partition_at=config.partition_at,
                 heal_at=config.heal_at,
                 impair_seed=config.seed)
+
+
+def _make_trace_writer(config, index, origin_indices, *, backend,
+                       params=None):
+    """Flight-recorder writer for ``--trace-dir`` (obs/trace.py), or None
+    (with a warning) when the run has no measured rounds to trace.
+
+    ``push_fanout`` is recorded post-clamp (the engine caps it at the
+    active-set size, engine/core.py round_step) so the manifest matches the
+    captured array shapes; the oracle path passes no ``params``, so its
+    prune cap resolves through the same EngineParams.prune_cap rule
+    (``--trace-prune-cap``; 0 = auto 16*N, capped at N*rc_slots) and the
+    two backends' manifests can never drift."""
+    # params.py is JAX-free, so the oracle path stays accelerator-agnostic
+    from .engine.params import EngineParams
+    from .obs.trace import TraceWriter
+
+    if config.gossip_iterations <= config.warm_up_rounds:
+        log.warning("WARNING: --trace-dir set but no measured rounds "
+                    "(iterations <= warm-up-rounds); no trace written")
+        return None
+    fanout = min(config.gossip_push_fanout, config.gossip_active_set_size)
+    if params is None:
+        params = EngineParams(num_nodes=len(index),
+                              trace_prune_cap=config.trace_prune_cap)
+    prune_cap = params.prune_cap
+    return TraceWriter(
+        config.trace_dir, backend=backend, num_nodes=len(index),
+        push_fanout=fanout,
+        active_set_size=config.gossip_active_set_size,
+        prune_cap=prune_cap,
+        origins=[int(i) for i in origin_indices],
+        origin_pubkeys=[index.pubkeys[int(i)].to_string()
+                        for i in origin_indices],
+        seed=config.seed, warm_up_rounds=config.warm_up_rounds,
+        iterations=config.gossip_iterations, config=config)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -195,6 +232,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "config, environment, span timings, throughput, "
                         "fault + influx counters (schema shared with "
                         "bench.py; see obs/report.py)")
+    p.add_argument("--trace-dir", default="", metavar="DIR",
+                   help="flight recorder (obs/trace.py): capture per-round "
+                        "protocol events (delivery edges + outcomes, first-"
+                        "delivery tree, prune pairs, rotations, active-set "
+                        "snapshots) of the measured rounds into DIR as a "
+                        "versioned npz trace (gossip-sim-tpu/trace/v1); "
+                        "analyze with tools/trace_report.py")
+    p.add_argument("--trace-origins", type=int, default=4,
+                   help="--all-origins mode: flight-record this many "
+                        "sampled origins (their per-origin RNG streams "
+                        "replay bit-identically outside the batch)")
+    p.add_argument("--trace-prune-cap", type=int, default=0,
+                   help="flight recorder: prune pairs captured per "
+                        "(origin, round); 0 = auto (16 * num_nodes). "
+                        "Raise when the trace manifest flags "
+                        "truncated_prune_rounds")
     p.add_argument("--checkpoint-path", default="",
                    help="save the simulation state (SimState arrays + "
                         "params) to this .npz after each measured block and "
@@ -256,6 +309,9 @@ def config_from_args(args) -> Config:
         mesh_devices=args.mesh_devices,
         jax_profile_dir=args.jax_profile_dir,
         run_report_path=args.run_report_path,
+        trace_dir=args.trace_dir,
+        trace_origins=args.trace_origins,
+        trace_prune_cap=args.trace_prune_cap,
     )
 
 
@@ -350,6 +406,28 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
             churn_recover_rate=config.churn_recover_rate,
             partition_at=config.partition_at, heal_at=config.heal_at)
 
+    tracer = collector = None
+    if config.trace_dir:
+        from .obs.trace import OracleTraceCollector
+        index = NodeIndex.from_stakes(accounts)
+        tracer = _make_trace_writer(
+            config, index, [index.index_of(origin_pubkey)],
+            backend="oracle")
+        if tracer is not None:
+            collector = OracleTraceCollector(
+                index, origin_pubkey,
+                push_fanout=min(config.gossip_push_fanout,
+                                config.gossip_active_set_size),
+                active_set_size=config.gossip_active_set_size,
+                prune_cap=tracer.manifest["prune_cap"])
+
+    def _flush_trace():
+        flushed = collector.flush()
+        if flushed is not None:
+            with reg.span("trace/write"):
+                seg = tracer.add_block(*flushed)
+            _push_sim_trace_point(dp_queue, sim_iter, start_ts, seg)
+
     cluster = Cluster(config.gossip_push_fanout)
     hb = Heartbeat(config.gossip_iterations, label="oracle rounds",
                    unit="iter")
@@ -366,6 +444,11 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
             impair.begin_round(it)
             if impair.has_churn:
                 cluster.apply_churn(impair, it, node_map)
+        trace_this = collector is not None and it >= config.warm_up_rounds
+        if trace_this:
+            # PRE-round snapshot: the active sets/pruned bits verb 1 is
+            # about to push through (the engine captures the same instant)
+            collector.begin_round(cluster, node_map)
         cluster.run_gossip(origin_pubkey, stakes, node_map, impair)
         cluster.consume_messages(origin_pubkey, nodes)
         cluster.send_prunes(origin_pubkey, nodes, config.prune_stake_threshold,
@@ -379,8 +462,14 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
             cluster.print_mst()
             cluster.print_pushes()
             cluster.print_prunes()
-        cluster.chance_to_rotate(rng, nodes, config.gossip_active_set_size,
-                                 stakes, config.probability_of_rotation)
+        rotated = cluster.chance_to_rotate(rng, nodes,
+                                           config.gossip_active_set_size,
+                                           stakes,
+                                           config.probability_of_rotation)
+        if trace_this:
+            collector.end_round(it, cluster, node_map, rotated)
+            if (it + 1 - config.warm_up_rounds) % 256 == 0:
+                _flush_trace()
         if it >= config.warm_up_rounds:
             # measured simulation compute only — warm-up rounds and the
             # stats harvest below stay out, mirroring the TPU path's
@@ -418,6 +507,10 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
                                    stats, steady, coverage, rmr_result)
             reg.record("stats/harvest", time.perf_counter() - t_h)
             reg.add("messages_delivered", rmr_result[1])
+    if collector is not None:
+        _flush_trace()
+        tracer.finalize()
+        log.info("protocol trace written to %s", config.trace_dir)
     if impair is not None and impair.has_churn:
         stats.set_failed_nodes(cluster.failed_nodes)
     return stakes
@@ -449,6 +542,7 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
                  if config.test_type == Testing.FAIL_NODES else -1),
         fail_fraction=(config.fraction_to_fail
                        if config.test_type == Testing.FAIL_NODES else 0.0),
+        trace_prune_cap=config.trace_prune_cap,
         **_impair_params(config),
     )
     with reg.span("engine/tables"):
@@ -457,6 +551,12 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
     reg.set_info("origin_batch", 1)
     origin_idx = index.index_of(origin_pubkey)
     origins = jnp.asarray([origin_idx], dtype=jnp.int32)
+
+    tracer = None
+    if config.trace_dir:
+        from .obs.trace import block_from_engine_rows
+        tracer = _make_trace_writer(config, index, [origin_idx],
+                                    backend="tpu", params=params)
 
     start_iter = 0
     if config.resume_path:
@@ -561,12 +661,18 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
             cm, counted = _engine_call_span(reg)
             with cm:
                 state, rows = run_rounds(params, tables, origins, state, n_it,
-                                         start_it=start_it, detail=True)
+                                         start_it=start_it, detail=True,
+                                         trace=tracer is not None)
                 rows = jax.tree_util.tree_map(np.asarray, rows)
             blk_wall = time.perf_counter() - t_blk
             if counted:
                 reg.add("origin_iters", n_it)
                 reg.add("messages_delivered", int(rows["delivered"].sum()))
+            if tracer is not None:
+                with reg.span("trace/write"):
+                    seg = tracer.add_block(start_it,
+                                           block_from_engine_rows(rows))
+                _push_sim_trace_point(dp_queue, sim_iter, start_ts, seg)
             with reg.span("stats/harvest"):
                 _warn_shape_truncation(rows, params)
                 if (params.fail_at >= 0
@@ -586,6 +692,9 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
             _push_sim_perf_point(dp_queue, sim_iter, start_ts, blk_wall,
                                  n_it, 1)
             _save_checkpoint(warm + done)
+    if tracer is not None:
+        tracer.finalize()
+        log.info("protocol trace written to %s", config.trace_dir)
     if config.jax_profile_dir:
         log.info("jax.profiler trace written to %s", config.jax_profile_dir)
 
@@ -692,6 +801,7 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         prune_stake_threshold=config.prune_stake_threshold,
         min_ingress_nodes=config.min_ingress_nodes,
         warm_up_rounds=config.warm_up_rounds,
+        trace_prune_cap=config.trace_prune_cap,
         **_impair_params(config),
     )
     reg = get_registry()
@@ -723,6 +833,15 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
             stats_list[0].get_validator_stake_distribution_histogram())
         dp.set_start()
         dp_queue.push_back(dp)
+
+    tracer = None
+    if config.trace_dir:
+        # one trace, one origin column per swept rank (per-origin RNG
+        # streams make each column bit-identical to its serial run)
+        from .obs.trace import block_from_engine_rows
+        tracer = _make_trace_writer(
+            config, index, [index.index_of(pk) for pk in origin_pks],
+            backend="tpu", params=params)
 
     log.info("Simulating Gossip and setting active sets. Please wait.....")
     with reg.span("engine/init"):
@@ -759,12 +878,17 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         cm, counted = _engine_call_span(reg)
         with cm:
             state, rows = run_rounds(params, tables, origins, state, n_it,
-                                     start_it=start_it, detail=True)
+                                     start_it=start_it, detail=True,
+                                     trace=tracer is not None)
             rows = jax.tree_util.tree_map(np.asarray, rows)
         blk_wall = time.perf_counter() - t_blk
         if counted:
             reg.add("origin_iters", R * n_it)
             reg.add("messages_delivered", int(rows["delivered"].sum()))
+        if tracer is not None:
+            with reg.span("trace/write"):
+                seg = tracer.add_block(start_it, block_from_engine_rows(rows))
+            _push_sim_trace_point(dp_queue, 0, start_ts, seg)
         with reg.span("stats/harvest"):
             _warn_shape_truncation(rows, params)
             for t in range(n_it):
@@ -783,10 +907,61 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         hb.beat(done)
         _push_sim_perf_point(dp_queue, 0, start_ts, blk_wall, n_it, R)
 
+    if tracer is not None:
+        tracer.finalize()
+        log.info("protocol trace written to %s", config.trace_dir)
     for col in range(R):
         _feed_message_counters(stats_list[col], state, col, index)
         _finalize_sim_stats(configs[col], stats_list[col], stakes,
                             stats_collection, dp_queue, col, start_ts)
+
+
+def _trace_replay_origins(config: Config, params, tables, index,
+                          origin_sample, dp_queue, start_ts):
+    """Flight-record a sampled origin subset of an --all-origins run.
+
+    Tracing every origin of an all-origins batch is shape-prohibitive
+    (rounds x origins x N x F), so the recorder replays the first
+    ``--trace-origins`` origins through a blocked traced scan instead.
+    Because each origin-sim's RNG stream folds only (seed, origin index,
+    iteration) — never the batch composition — the replayed rounds are
+    bit-identical to those origins' sims inside the batch: the trace IS the
+    batch's trace for the sampled columns.  Replay time is bounded by the
+    sample size and stays out of the engine/rounds throughput spans."""
+    import jax
+    import jax.numpy as jnp
+
+    from .engine import init_state, run_rounds
+    from .obs.trace import block_from_engine_rows
+
+    reg = get_registry()
+    tracer = _make_trace_writer(config, index, origin_sample, backend="tpu",
+                                params=params)
+    if tracer is None:     # no measured rounds (already warned)
+        return
+    origins = jnp.asarray(origin_sample, dtype=jnp.int32)
+    warm = min(config.warm_up_rounds, config.gossip_iterations)
+    measured = config.gossip_iterations - warm
+    log.info("all-origins: flight-recording %s sampled origin(s) "
+             "(bit-identical replay) into %s", len(origin_sample),
+             config.trace_dir)
+    with reg.span("trace/replay"):
+        state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
+                           params)
+        if warm > 0:
+            state, _ = run_rounds(params, tables, origins, state, warm)
+        done, block = 0, 256
+        while done < measured:
+            n_it = min(block, measured - done)
+            state, rows = run_rounds(params, tables, origins, state, n_it,
+                                     start_it=warm + done, detail=True,
+                                     trace=True)
+            rows = jax.tree_util.tree_map(np.asarray, rows)
+            seg = tracer.add_block(warm + done, block_from_engine_rows(rows))
+            _push_sim_trace_point(dp_queue, 0, start_ts, seg)
+            done += n_it
+    tracer.finalize()
+    log.info("protocol trace written to %s", config.trace_dir)
 
 
 def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
@@ -824,6 +999,7 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         prune_stake_threshold=config.prune_stake_threshold,
         min_ingress_nodes=config.min_ingress_nodes,
         warm_up_rounds=config.warm_up_rounds,
+        trace_prune_cap=config.trace_prune_cap,
         **_impair_params(config),
     )
     with reg.span("engine/tables"):
@@ -906,6 +1082,16 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         hb.beat(min(lo + n_valid, total_o))
     dt = time.time() - t0
 
+    if config.trace_dir:
+        if config.trace_origins <= 0:
+            log.warning("WARNING: --trace-dir set with --trace-origins 0; "
+                        "no trace written")
+        else:
+            sample = [int(o) for o in
+                      all_origins[:min(config.trace_origins, total_o)]]
+            _trace_replay_origins(config, params, tables, index, sample,
+                                  dp_queue, start_ts)
+
     if agg.measured_points == 0:
         log.warning("WARNING: no measured rounds (iterations <= "
                     "warm-up-rounds); skipping stats/influx")
@@ -957,6 +1143,18 @@ def _push_sim_perf_point(dp_queue, sim_iter, start_ts, block_wall_s, n_iters,
     dp = InfluxDataPoint(start_ts, sim_iter)
     dp.create_sim_perf_point(round(block_wall_s, 6), round(thr, 2),
                              len(dp_queue), n_iters)
+    dp_queue.push_back(dp)
+
+
+def _push_sim_trace_point(dp_queue, sim_iter, start_ts, seg):
+    """Flight-recorder series: one point per trace segment flush (rounds
+    captured, delivered edges, prune pairs, bytes written)."""
+    if dp_queue is None or seg is None:
+        return
+    dp = InfluxDataPoint(start_ts, sim_iter)
+    dp.create_sim_trace_point(seg["end_round"] - seg["start_round"],
+                              seg["delivered_edges"], seg["prunes"],
+                              seg["bytes"])
     dp_queue.push_back(dp)
 
 
@@ -1248,6 +1446,11 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
             start = float(config.churn_fail_rate)
         else:  # NO_TEST
             c, start = config, 0.0
+        if config.trace_dir and config.num_simulations > 1:
+            # one flight-recorder directory per swept simulation; each
+            # holds its own manifest + segments
+            c = c.stepped(trace_dir=os.path.join(config.trace_dir,
+                                                 f"sim{i:03d}"))
         run_simulation(c, json_rpc_url, collection, dp_queue, i, start_ts,
                        start)
         hb.beat(i + 1)

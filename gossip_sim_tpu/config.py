@@ -119,6 +119,17 @@ class Config:
                                     # round/* named_scope stages (obs/)
     run_report_path: str = ""       # write the machine-readable run report
                                     # (obs/report.py schema) to this path
+    trace_dir: str = ""             # flight recorder (obs/trace.py): write
+                                    # per-round protocol event traces
+                                    # (schema gossip-sim-tpu/trace/v1) here
+    trace_origins: int = 4          # --all-origins mode: how many sampled
+                                    # origins to flight-record (per-origin
+                                    # RNG streams make the sampled replay
+                                    # bit-identical to the batched sims)
+    trace_prune_cap: int = 0        # prune pairs captured per (origin,
+                                    # round); 0 = auto (16*num_nodes).
+                                    # Raise when the trace manifest flags
+                                    # truncated_prune_rounds
 
     def stepped(self, **kw) -> "Config":
         return replace(self, **kw)

@@ -73,6 +73,8 @@ from jax import lax
 from ..faults import (SALT_CHURN, SALT_EDGE, edge_u32_arr, node_u32_arr,
                       rate_threshold, round_basis_arr, stake_bipartition)
 from ..identity import stake_buckets_array
+from ..obs.trace import (TRACE_CANDIDATE, TRACE_DROPPED, TRACE_FAILED_TARGET,
+                         TRACE_SUPPRESSED)
 from .params import EngineParams
 from .sampler import SamplerTables, build_sampler_tables
 
@@ -328,8 +330,17 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
 
 def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
                state: SimState, it: jax.Array, detail: bool = False,
-               edge_detail: bool = False):
-    """One full gossip round for all O origin-sims.  Returns (state, rows)."""
+               edge_detail: bool = False, trace: bool = False):
+    """One full gossip round for all O origin-sims.  Returns (state, rows).
+
+    ``trace`` additionally emits the flight-recorder event rows consumed by
+    :mod:`gossip_sim_tpu.obs.trace` (candidate push slots with per-edge
+    outcome codes, first-delivery senders, prune pairs, rotation events and
+    the pre-round active-set snapshot).  The trace rows are pure extra
+    outputs computed from intermediates the round already materializes: the
+    state transition and every non-trace row are bit-identical with the
+    flag on or off, and with it off (the default) the compiled graph is
+    unchanged."""
     p = params
     N, S, F, C, K, H = (p.num_nodes, p.active_set_size, p.push_fanout,
                         p.rc_slots, p.k_inbound, p.hist_bins)
@@ -420,6 +431,19 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         tgt = jnp.where(deliver_ok, peerF, N)                        # [O,N,F]
         tgtf = tgt.reshape(O, NF)
         pseudo_t = jnp.broadcast_to(iota_n, (O, N))
+        if trace:
+            # flight recorder: candidate target per fanout slot + outcome
+            # code, mirroring the oracle's classify_edge precedence
+            # (failed target > partition > loss > deliverable candidate)
+            trace_peers = jnp.where(slot_ok, peerF, -1)
+            t_code = jnp.where(slot_ok, jnp.int32(TRACE_CANDIDATE), 0)
+            t_code = jnp.where(slot_ok & (tfail_sf[..., :F] == 1),
+                               TRACE_FAILED_TARGET, t_code)
+            if sup_mask is not None:
+                t_code = jnp.where(sup_mask, TRACE_SUPPRESSED, t_code)
+            if drop_mask is not None:
+                t_code = jnp.where(drop_mask, TRACE_DROPPED, t_code)
+            trace_code = t_code
 
     with jax.named_scope("round/bfs_propagate"):
         # ---- BFS frontier relaxation: two 1-key sorts per hop ---------------
@@ -490,6 +514,16 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         rank = _rank_in_run(st_)
         is_pseudo = (skv == BIG) & (st_ < N)
         real = (skv != BIG) & (st_ < N)
+
+        if trace:
+            # first-delivery sender per receiver: each target's run starts
+            # with its rank-0 entry — the minimum (hop, src) inbound edge
+            # when any exists, else the pseudo (kv == BIG).  One 1-key sort
+            # compacts the N rank-0 entries into target order.
+            fd_k = jnp.where((rank == 0) & (st_ < N), st_, BIG)
+            _, fd_kv = lax.sort((fd_k, skv), dimension=-1, num_keys=1)
+            fkv = fd_kv[:, :N]
+            trace_first = jnp.where(fkv != BIG, fkv & (pack - 1), -1)
 
         # ingress counts: the pseudo entry sorts last in its run, so its rank is
         # the number of delivered edges into its target; compact runs -> [O, N].
@@ -618,6 +652,35 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         n_pruned = jnp.sum(pruned_slot, axis=-1, dtype=jnp.int32)    # [O, N] per pruner
         m_prunes = jnp.sum(n_pruned, axis=-1, dtype=jnp.int32)       # [O]
         # Prune messages count toward RMR's m (gossip.rs:684-687).
+        if trace:
+            # flight recorder: compact the sparse (pruner, prunee) pairs to
+            # the first prune_cap slots via one full-width 1-key sort; the
+            # writer cross-checks the captured count against prunes_sent and
+            # flags truncated rounds in the manifest (never silent).  Most
+            # rounds emit no prunes at all (they batch at the upsert
+            # threshold), so the sort hides behind a lax.cond and zero-prune
+            # rounds pay nothing.
+            PC = p.prune_cap
+
+            def _prune_pairs():
+                live_flat = pruned_slot.reshape(O, N * C)
+                pk_flat = jnp.where(
+                    live_flat,
+                    jnp.arange(N * C, dtype=jnp.int32)[None, :], BIG)
+                pruner_flat = jnp.broadcast_to(
+                    iota_n[:, :, None], (O, N, C)).reshape(O, N * C)
+                prunee_flat = src_sorted.reshape(O, N * C)
+                pks, tps, tpd = lax.sort(
+                    (pk_flat, pruner_flat, prunee_flat),
+                    dimension=-1, num_keys=1)
+                pair_ok = pks[:, :PC] != BIG
+                return (jnp.where(pair_ok, tps[:, :PC], -1),
+                        jnp.where(pair_ok, tpd[:, :PC], -1))
+
+            trace_prune_src, trace_prune_dst = lax.cond(
+                m_prunes.sum() > 0, _prune_pairs,
+                lambda: (jnp.full((O, PC), -1, jnp.int32),
+                         jnp.full((O, PC), -1, jnp.int32)))
 
     with jax.named_scope("round/verb4_prune_apply"):
         # ---- verb 4: prune apply (push_active_set.rs:56-71,143-151) ---------
@@ -796,7 +859,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
             "hop_clamped": jnp.sum(reached & (dist >= H), axis=-1,
                                    dtype=jnp.int32),
         }
-        if detail:
+        if detail or trace:
             rows["stranded_mask"] = stranded
             rows["dist"] = jnp.where(reached, dist, -1).astype(jnp.int32)
             rows["failed_mask"] = failed
@@ -807,6 +870,20 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
             rows["push_targets"] = jnp.where(delivered, tgt, -1)
             rows["edge_hops"] = jnp.where(
                 delivered, jnp.broadcast_to(hop1[:, :, None], (O, N, F)), -1)
+        if trace:
+            # flight-recorder rows (obs/trace.py): candidate slots + outcome
+            # codes, first-delivery senders, prune pairs, rotation events,
+            # and the PRE-round active-set snapshot the round pushed through
+            # (verb 5 rotates only after delivery, so ``peer``/state.pruned
+            # are what verb 1 actually consulted this round).
+            rows["trace_peers"] = trace_peers
+            rows["trace_code"] = trace_code
+            rows["trace_first"] = trace_first
+            rows["trace_prune_src"] = trace_prune_src
+            rows["trace_prune_dst"] = trace_prune_dst
+            rows["trace_rot"] = jnp.where(do_rot, chosen, -1)
+            rows["trace_active"] = jnp.where(peer < N, peer, -1)
+            rows["trace_pruned"] = state.pruned
     return new_state, rows
 
 
@@ -814,22 +891,25 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
 # multi-round runner
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnums=(0, 4, 5, 6), donate_argnums=(3,))
+@partial(jax.jit, static_argnums=(0, 4, 5, 6, 7), donate_argnums=(3,))
 def _run(params, tables, origins, state, num_iters, detail, edge_detail,
-         start_it):
+         trace, start_it):
     def step(st, it):
         return round_step(params, tables, origins, st, it, detail=detail,
-                          edge_detail=edge_detail)
+                          edge_detail=edge_detail, trace=trace)
     its = jnp.arange(num_iters) + start_it
     return lax.scan(step, state, its)
 
 
 def run_rounds(params: EngineParams, tables: ClusterTables, origins: jax.Array,
                state: SimState, num_iters: int, start_it=0,
-               detail: bool = False, edge_detail: bool = False):
+               detail: bool = False, edge_detail: bool = False,
+               trace: bool = False):
     """Run ``num_iters`` rounds under one jitted scan (the reference's hot
     loop, gossip_main.rs:425-565).  Returns (state, rows-of-arrays with a
     leading [num_iters] axis).  ``edge_detail`` additionally exports the
-    per-edge (src, fanout-slot) -> (target, hop) matrices per round."""
+    per-edge (src, fanout-slot) -> (target, hop) matrices per round;
+    ``trace`` the flight-recorder event rows (obs/trace.py)."""
     return _run(params, tables, origins, state, int(num_iters), bool(detail),
-                bool(edge_detail), jnp.asarray(start_it, jnp.int32))
+                bool(edge_detail), bool(trace),
+                jnp.asarray(start_it, jnp.int32))

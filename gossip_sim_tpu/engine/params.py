@@ -54,6 +54,14 @@ class EngineParams(NamedTuple):
     pa_slots: int = 8       # prune-apply fast-path budget (pruned peers per
                             # row per round); overflow falls back to the
                             # full-width sort via lax.cond — exact either way
+    trace_prune_cap: int = 0  # flight-recorder (obs/trace.py) prune-pair
+                              # slots captured per (origin, round); 0 = auto
+                              # (16*num_nodes — the first prune burst is
+                              # nearly synchronized across nodes, so the
+                              # cap must hold several pairs per node at
+                              # once).  Overflow is counted, never silently
+                              # dropped — only the trace truncates, the
+                              # simulation itself is unaffected.
 
     @property
     def num_buckets(self) -> int:
@@ -69,6 +77,14 @@ class EngineParams(NamedTuple):
     @property
     def has_churn(self) -> bool:
         return self.churn_fail_rate > 0.0 or self.churn_recover_rate > 0.0
+
+    @property
+    def prune_cap(self) -> int:
+        """Resolved flight-recorder prune-pair capture width per round
+        (``trace_prune_cap``; 0 = auto: 16*num_nodes, never more than the
+        theoretical N*rc_slots maximum)."""
+        cap = self.trace_prune_cap or 16 * self.num_nodes
+        return min(cap, self.num_nodes * self.rc_slots)
 
     @property
     def k_inbound(self) -> int:

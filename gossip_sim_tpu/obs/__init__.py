@@ -10,10 +10,14 @@ from .report import (PER_CHIP_TARGET, RUN_REPORT_SCHEMA, bench_summary,
                      build_run_report, environment_info, validate_run_report,
                      write_run_report)
 from .spans import SpanRegistry, get_registry, span
+from .trace import (TRACE_SCHEMA, OracleTraceCollector, Trace, TraceWriter,
+                    load_trace, validate_trace_dir, validate_trace_manifest)
 
 __all__ = [
     "Heartbeat", "SpanRegistry", "get_registry", "span",
     "PER_CHIP_TARGET", "RUN_REPORT_SCHEMA", "bench_summary",
     "build_run_report", "environment_info", "validate_run_report",
     "write_run_report",
+    "TRACE_SCHEMA", "OracleTraceCollector", "Trace", "TraceWriter",
+    "load_trace", "validate_trace_dir", "validate_trace_manifest",
 ]

@@ -38,11 +38,20 @@ class Heartbeat:
         self._last = self._t0
 
     def _format(self, done: int, now: float) -> str:
-        elapsed = now - self._t0
+        # Hardened for the degenerate ticks (ISSUE 3): done < 0 or beyond
+        # total is clamped; zero completed steps (or a zero-elapsed first
+        # tick) reports rate 0 and ETA "?" instead of dividing by zero; a
+        # finished loop always reports ETA 0:00:00 even when the rate is
+        # unmeasurable (the single-step case: total=1, first beat is the
+        # last).  ETA never goes negative.
+        done = max(0, min(done, self.total) if self.total else done)
+        elapsed = max(0.0, now - self._t0)
         pct = 100.0 * done / self.total if self.total else 0.0
         rate = done / elapsed if elapsed > 0 else 0.0
-        if rate > 0 and self.total:
-            eta = _fmt_hms((self.total - done) / rate)
+        if self.total and done >= self.total:
+            eta = _fmt_hms(0)
+        elif rate > 0 and self.total:
+            eta = _fmt_hms(max(0.0, (self.total - done) / rate))
         else:
             eta = "?"
         return (f"HEARTBEAT {self.label}: {done}/{self.total} {self.unit}s "

@@ -1,0 +1,601 @@
+"""Protocol flight recorder: message-level delivery/prune traces.
+
+The stats layer answers *what* a topology did (coverage, RMR, LDH,
+stranded counts); this module records *why*: with ``--trace-dir`` set, every
+measured round's protocol events are captured as fixed-shape arrays inside
+the engine's ``round_step`` (or rebuilt from the oracle's per-round state)
+and written as versioned ``.npz`` segments plus a JSON manifest, so any run
+can be replayed and root-caused offline with ``tools/trace_report.py`` —
+no re-run with print statements.
+
+Per traced round (leading axes ``[rounds, O]``; node ids are int16,
+``-1`` = none/empty):
+
+* ``peers``   [O,N,F]  candidate push target per fanout slot — the first F
+                       valid (unpruned, non-origin) active-set slots, the
+                       exact list verb 1 pushed through this round
+* ``code``    [O,N,F]  per-slot outcome: 0 empty, 1 deliverable candidate,
+                       2 failed target, 3 partition-suppressed, 4 loss-
+                       dropped (precedence matches faults.classify_edge);
+                       a candidate actually delivers iff its source was
+                       reached this round (``dist[src] >= 0``)
+* ``dist``    [O,N]    hop distance from the origin (-1 unreached)
+* ``first_src`` [O,N]  first-delivery sender: the minimum (hop, src-index)
+                       inbound edge — identical to the reference's
+                       (hops, pubkey-string) consume ranking because
+                       NodeIndex assigns indices in pubkey-string order
+* ``failed``  [O,N]    node-failure mask after this round's churn/fail step
+* ``rot``     [O,N]    rotation events: engine = rotated-in peer id;
+                       oracle = 1 for nodes that re-sampled (its rotation
+                       replaces the whole entry, a documented divergence)
+* ``active``  [O,N,S]  PRE-round active-set snapshot (what verb 1 consulted)
+* ``pruned``  [O,N,S]  PRE-round per-slot pruned bits for this origin
+* ``prune_src``/``prune_dst`` [O,P]  prune pairs emitted this round
+                       (pruner, prunee); P = ``EngineParams.prune_cap``
+                       slots, overflow flagged in the manifest, never
+                       silently dropped
+* ``coverage`` [O]     fraction reached (cross-check vs the stats layer)
+* ``prunes_total`` [O] total prune messages (the ``prunes_sent`` row)
+
+Segments are written atomically (temp + ``os.replace``, like checkpoints)
+and named by round range, so a ``--resume`` continuation appends new
+segments without duplicating or losing already-traced rounds.  The
+manifest (``manifest.json``, schema ``gossip-sim-tpu/trace/v1``) is keyed
+to the run-report schema from obs/report.py: it embeds the same JSON-safe
+``config`` block and cross-references ``run_report_schema`` so a trace and
+its run report can always be joined on (seed, config, round range).
+
+Everything here is numpy-only: importing this module (and the ``obs``
+package) never touches JAX.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+
+import numpy as np
+
+from .report import RUN_REPORT_SCHEMA, config_dict
+
+log = logging.getLogger("gossip_sim_tpu.obs")
+
+TRACE_SCHEMA = "gossip-sim-tpu/trace/v1"
+MANIFEST_NAME = "manifest.json"
+
+# per-slot outcome codes (shared with engine/core.py round_step and the
+# oracle collector; precedence: failed target > suppressed > dropped)
+TRACE_EMPTY = 0
+TRACE_CANDIDATE = 1
+TRACE_FAILED_TARGET = 2
+TRACE_SUPPRESSED = 3
+TRACE_DROPPED = 4
+TRACE_CODE_NAMES = {
+    TRACE_EMPTY: "empty",
+    TRACE_CANDIDATE: "candidate",
+    TRACE_FAILED_TARGET: "failed_target",
+    TRACE_SUPPRESSED: "suppressed",
+    TRACE_DROPPED: "dropped",
+}
+
+#: segment arrays: name -> (on-disk dtype, symbolic per-round shape suffix).
+#: Dims: N nodes, F push fanout, S active-set size, P prune_cap.
+ARRAY_SPECS = {
+    "peers": ("int16", ("N", "F")),
+    "code": ("int8", ("N", "F")),
+    "dist": ("int16", ("N",)),
+    "first_src": ("int16", ("N",)),
+    "failed": ("bool", ("N",)),
+    "rot": ("int16", ("N",)),
+    "active": ("int16", ("N", "S")),
+    "pruned": ("bool", ("N", "S")),
+    "prune_src": ("int16", ("P",)),
+    "prune_dst": ("int16", ("P",)),
+    "coverage": ("float32", ()),
+    "prunes_total": ("int32", ()),
+}
+
+#: engine row name -> segment array name (detail + trace rows, cli harvest)
+_ENGINE_ROW_MAP = {
+    "trace_peers": "peers",
+    "trace_code": "code",
+    "dist": "dist",
+    "trace_first": "first_src",
+    "failed_mask": "failed",
+    "trace_rot": "rot",
+    "trace_active": "active",
+    "trace_pruned": "pruned",
+    "trace_prune_src": "prune_src",
+    "trace_prune_dst": "prune_dst",
+    "coverage": "coverage",
+    "prunes_sent": "prunes_total",
+}
+
+_MATCH_KEYS = ("schema", "backend", "num_nodes", "push_fanout",
+               "active_set_size", "prune_cap", "seed", "origins")
+
+
+def block_from_engine_rows(rows) -> dict:
+    """Engine harvest rows (numpy, ``[R, O, ...]``) -> writer block dict."""
+    return {seg: np.asarray(rows[eng]) for eng, seg in _ENGINE_ROW_MAP.items()}
+
+
+def _atomic_write_bytes(path: str, payload: bytes) -> None:
+    fd, tmp = tempfile.mkstemp(prefix=".trace-",
+                               dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _atomic_savez(path: str, arrays: dict) -> int:
+    fd, tmp = tempfile.mkstemp(suffix=".npz", prefix=".trace-",
+                               dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        size = os.path.getsize(tmp)
+        os.replace(tmp, path)
+        return size
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class TraceWriter:
+    """Incremental flight-recorder writer: one ``.npz`` segment per harvest
+    block, one merged ``manifest.json`` (updated after every segment so a
+    killed run still leaves a loadable trace).
+
+    On construction against a directory that already holds a manifest for
+    the *same* run geometry (num_nodes, fanout, seed, origins, backend, ...)
+    existing segments are kept and new ones merged in — the ``--resume``
+    composition contract: a checkpoint restart appends the remaining rounds
+    without duplicating or losing already-traced ones.  A mismatched
+    manifest is replaced (with a warning).
+    """
+
+    #: node ids are stored int16; the engine's MAX_NODES shares this bound,
+    #: but the oracle backend has no intrinsic cap, so the writer enforces it
+    MAX_TRACE_NODES = 32767
+
+    def __init__(self, trace_dir: str, *, backend: str, num_nodes: int,
+                 push_fanout: int, active_set_size: int, prune_cap: int,
+                 origins, origin_pubkeys, seed: int, warm_up_rounds: int,
+                 iterations: int, config=None):
+        if num_nodes > self.MAX_TRACE_NODES:
+            raise ValueError(
+                f"trace arrays store node ids as int16; num_nodes must be "
+                f"<= {self.MAX_TRACE_NODES}, got {num_nodes}")
+        self.trace_dir = trace_dir
+        os.makedirs(trace_dir, exist_ok=True)
+        self.manifest = {
+            "schema": TRACE_SCHEMA,
+            "run_report_schema": RUN_REPORT_SCHEMA,
+            "backend": str(backend),
+            "num_nodes": int(num_nodes),
+            "push_fanout": int(push_fanout),
+            "active_set_size": int(active_set_size),
+            "prune_cap": int(prune_cap),
+            "origins": [int(o) for o in origins],
+            "origin_pubkeys": [str(p) for p in origin_pubkeys],
+            "seed": int(seed),
+            "warm_up_rounds": int(warm_up_rounds),
+            "iterations": int(iterations),
+            "codes": {str(k): v for k, v in TRACE_CODE_NAMES.items()},
+            "arrays": {name: {"dtype": dt, "dims": list(dims)}
+                       for name, (dt, dims) in ARRAY_SPECS.items()},
+            "config": config_dict(config) if config is not None else {},
+            "segments": [],
+        }
+        prior = self._load_existing_manifest()
+        if prior is not None:
+            self.manifest["segments"] = prior.get("segments", [])
+
+    # -- resume merge -----------------------------------------------------
+
+    def _load_existing_manifest(self):
+        path = os.path.join(self.trace_dir, MANIFEST_NAME)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path) as f:
+                prior = json.load(f)
+        except (OSError, ValueError) as e:
+            log.warning("WARNING: unreadable trace manifest %s (%s); "
+                        "starting a fresh trace", path, e)
+            return None
+        mismatch = [k for k in _MATCH_KEYS
+                    if prior.get(k) != self.manifest.get(k)]
+        if mismatch:
+            log.warning("WARNING: existing trace in %s was recorded under a "
+                        "different run (%s differ); replacing it",
+                        self.trace_dir, ", ".join(mismatch))
+            return None
+        log.info("trace: resuming into %s (%s prior segment(s) kept)",
+                 self.trace_dir, len(prior.get("segments", [])))
+        return prior
+
+    # -- segments ---------------------------------------------------------
+
+    def add_block(self, start_round: int, block: dict) -> dict:
+        """Write one harvest block (arrays ``[R, O, ...]``) as a segment.
+
+        Returns a summary dict: file, round range, delivered-edge / prune
+        counts, bytes written, and the rounds whose prune capture hit the
+        ``prune_cap`` truncation ceiling.
+        """
+        n_rounds = None
+        out = {}
+        for name, (dtype, _) in ARRAY_SPECS.items():
+            if name not in block:
+                raise ValueError(f"trace block missing array: {name}")
+            arr = np.asarray(block[name])
+            if n_rounds is None:
+                n_rounds = arr.shape[0]
+            elif arr.shape[0] != n_rounds:
+                raise ValueError(
+                    f"trace block round-axis mismatch for {name}: "
+                    f"{arr.shape[0]} != {n_rounds}")
+            out[name] = arr.astype(np.dtype(dtype), copy=False)
+        start = int(start_round)
+        end = start + int(n_rounds)
+        out["rounds"] = np.arange(start, end, dtype=np.int32)
+
+        delivered = int(np.count_nonzero(
+            (out["code"] == TRACE_CANDIDATE)
+            & (out["dist"] >= 0)[..., None]))
+        captured_pairs = np.count_nonzero(out["prune_src"] >= 0,
+                                          axis=-1)                 # [R, O]
+        total_prunes = out["prunes_total"]
+        truncated = sorted(
+            int(out["rounds"][t])
+            for t in range(n_rounds)
+            if (total_prunes[t] > captured_pairs[t]).any())
+        if truncated:
+            log.warning("WARNING: trace prune capture truncated at "
+                        "prune_cap=%s in round(s) %s — raise "
+                        "EngineParams.trace_prune_cap for full prune "
+                        "lineage", self.manifest["prune_cap"], truncated)
+
+        fname = f"seg-{start:06d}-{end:06d}.npz"
+        size = _atomic_savez(os.path.join(self.trace_dir, fname), out)
+        summary = {
+            "file": fname,
+            "start_round": start,
+            "end_round": end,
+            "delivered_edges": delivered,
+            "prunes": int(total_prunes.sum()),
+            "truncated_prune_rounds": truncated,
+            "bytes": size,
+        }
+        self._merge_segment(summary)
+        self._write_manifest()
+        return summary
+
+    def _merge_segment(self, summary: dict) -> None:
+        """Replace any existing segment overlapping the new round range
+        (a resume re-running the same block overwrites it bit-identically;
+        partially-overlapping stale segments are dropped, never doubled)."""
+        s, e = summary["start_round"], summary["end_round"]
+        kept = []
+        for seg in self.manifest["segments"]:
+            if seg["start_round"] < e and s < seg["end_round"]:
+                if (seg["start_round"], seg["end_round"]) != (s, e):
+                    log.warning("trace: dropping stale overlapping segment "
+                                "%s", seg["file"])
+                    try:
+                        os.unlink(os.path.join(self.trace_dir, seg["file"]))
+                    except OSError:
+                        pass
+                continue
+            kept.append(seg)
+        kept.append(summary)
+        kept.sort(key=lambda g: g["start_round"])
+        self.manifest["segments"] = kept
+
+    def _write_manifest(self) -> None:
+        payload = (json.dumps(self.manifest, indent=2) + "\n").encode()
+        _atomic_write_bytes(os.path.join(self.trace_dir, MANIFEST_NAME),
+                            payload)
+
+    def finalize(self) -> dict:
+        """Final manifest write; returns the manifest dict."""
+        self._write_manifest()
+        segs = self.manifest["segments"]
+        rounds = sum(g["end_round"] - g["start_round"] for g in segs)
+        log.info("trace: %s segment(s), %s round(s) in %s", len(segs),
+                 rounds, self.trace_dir)
+        return self.manifest
+
+
+# --------------------------------------------------------------------------
+# oracle-side collector
+# --------------------------------------------------------------------------
+
+class OracleTraceCollector:
+    """Build engine-shaped trace blocks from the CPU oracle's per-round
+    state (``oracle/cluster.py``).
+
+    Divergences vs the engine capture, both documented here and visible in
+    the manifest ``backend`` field: the oracle only *attempts* pushes from
+    reached nodes, so ``peers``/``code`` rows of unreached sources stay
+    empty (the engine records every node's candidate slots); and its
+    rotation re-samples whole entries, so ``rot`` is a 0/1 event flag, not
+    a rotated-in peer id.  ``first_src``, ``dist``, delivered edges, prune
+    pairs and the active/pruned snapshots are definitionally identical —
+    that is the bit-parity surface tests/test_trace.py locks down.
+    """
+
+    def __init__(self, index, origin_pubkey, *, push_fanout: int,
+                 active_set_size: int, prune_cap: int):
+        self.index = index
+        self.origin_pk = origin_pubkey
+        self.origin_idx = index.index_of(origin_pubkey)
+        self.F = int(push_fanout)
+        self.S = int(active_set_size)
+        self.P = int(prune_cap)
+        self.N = len(index)
+        self._pre = None
+        self._rounds = []     # [(round, {name: [O=1, ...] array})]
+
+    def begin_round(self, cluster, node_map) -> None:
+        """PRE-round snapshot (active sets + pruned bits as verb 1 will see
+        them) and arm the cluster's edge log for this round."""
+        from ..identity import get_stake_bucket
+
+        N, S = self.N, self.S
+        active = np.full((N, S), -1, np.int16)
+        pruned = np.zeros((N, S), bool)
+        origin_stake = node_map[self.origin_pk].stake
+        for i, pk in enumerate(self.index.pubkeys):
+            node = node_map[pk]
+            bucket = get_stake_bucket(min(node.stake, origin_stake))
+            entry = node.active_set.entries[bucket]
+            for s, (peer, filt) in enumerate(entry.peers.items()):
+                if s >= S:
+                    break
+                active[i, s] = self.index.index_of(peer)
+                pruned[i, s] = self.origin_pk in filt
+        self._pre = (active, pruned)
+        cluster.edge_log = []
+
+    def end_round(self, it: int, cluster, node_map, rotated_pks) -> None:
+        """Collect the round's events after verbs 1-5 ran."""
+        from ..constants import UNREACHED
+
+        N, F, P = self.N, self.F, self.P
+        idx_of = self.index.index_of
+        active, pruned = self._pre
+        self._pre = None
+
+        peers = np.full((N, F), -1, np.int16)
+        code = np.zeros((N, F), np.int8)
+        slot_fill = np.zeros(N, np.int32)
+        for src_pk, dst_pk, c in cluster.edge_log or ():
+            si = idx_of(src_pk)
+            k = slot_fill[si]
+            if k < F:
+                peers[si, k] = idx_of(dst_pk)
+                code[si, k] = c
+            slot_fill[si] += 1
+        cluster.edge_log = None
+
+        dist = np.full(N, -1, np.int16)
+        for pk, d in cluster.distances.items():
+            if d != UNREACHED:
+                dist[idx_of(pk)] = d
+
+        first = np.full(N, -1, np.int16)
+        for dst_pk, srcs in cluster.orders.items():
+            best = min((hops, idx_of(src_pk))
+                       for src_pk, hops in srcs.items())
+            first[idx_of(dst_pk)] = best[1]
+
+        prune_src = np.full(P, -1, np.int16)
+        prune_dst = np.full(P, -1, np.int16)
+        total_prunes = 0
+        k = 0
+        for pruner_pk, prunes in cluster.prunes.items():
+            for prunee_pk, origins_list in prunes.items():
+                total_prunes += len(origins_list)
+                if k < P:
+                    prune_src[k] = idx_of(pruner_pk)
+                    prune_dst[k] = idx_of(prunee_pk)
+                    k += 1
+
+        failed = np.array([node_map[pk].failed for pk in self.index.pubkeys],
+                          dtype=bool)
+        rot = np.full(N, -1, np.int16)
+        for pk in rotated_pks or ():
+            rot[idx_of(pk)] = 1
+
+        row = {
+            "peers": peers, "code": code, "dist": dist, "first_src": first,
+            "failed": failed, "rot": rot, "active": active, "pruned": pruned,
+            "prune_src": prune_src, "prune_dst": prune_dst,
+            "coverage": np.float32(len(cluster.visited) / N),
+            "prunes_total": np.int32(total_prunes),
+        }
+        self._rounds.append((int(it), row))
+
+    def flush(self):
+        """-> (start_round, block arrays ``[R, 1, ...]``) or None if empty.
+        Collected rounds must be contiguous (they are: one per iteration)."""
+        if not self._rounds:
+            return None
+        start = self._rounds[0][0]
+        block = {
+            name: np.stack([row[name] for _, row in self._rounds])[:, None]
+            for name in ARRAY_SPECS
+        }
+        self._rounds = []
+        return start, block
+
+
+# --------------------------------------------------------------------------
+# loading + validation
+# --------------------------------------------------------------------------
+
+class Trace:
+    """A loaded trace: manifest + segment arrays concatenated on the round
+    axis (``rounds[t]`` is the absolute round index of slice ``t``)."""
+
+    def __init__(self, manifest: dict, rounds: np.ndarray, arrays: dict,
+                 gaps=None):
+        self.manifest = manifest
+        self.rounds = rounds
+        self.arrays = arrays
+        self.gaps = list(gaps or [])
+
+    def __len__(self):
+        return int(self.rounds.shape[0])
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.manifest["num_nodes"])
+
+    @property
+    def origins(self) -> list:
+        return list(self.manifest["origins"])
+
+    def col_of(self, origin: int) -> int:
+        """Column index of an origin node id."""
+        return self.origins.index(int(origin))
+
+    def pos_of(self, round_idx: int) -> int:
+        """Round-axis position of an absolute round index."""
+        t = int(np.searchsorted(self.rounds, round_idx))
+        if t >= len(self) or self.rounds[t] != round_idx:
+            raise KeyError(f"round {round_idx} not in trace "
+                           f"(have {self.rounds[0]}..{self.rounds[-1]})")
+        return t
+
+    def at(self, round_idx: int) -> dict:
+        """All arrays for one absolute round: ``{name: [O, ...]}``."""
+        t = self.pos_of(round_idx)
+        return {name: arr[t] for name, arr in self.arrays.items()}
+
+
+def load_trace(trace_dir: str) -> Trace:
+    """Read ``manifest.json`` + every listed segment; concatenate on the
+    round axis.  Raises on a missing/invalid manifest or segment; round
+    gaps (e.g. a crashed run that never resumed) load fine and are listed
+    in ``Trace.gaps``."""
+    path = os.path.join(trace_dir, MANIFEST_NAME)
+    with open(path) as f:
+        manifest = json.load(f)
+    problems = validate_trace_manifest(manifest)
+    if problems:
+        raise ValueError(f"invalid trace manifest {path}: {problems}")
+    segs = sorted(manifest["segments"], key=lambda g: g["start_round"])
+    if not segs:
+        raise ValueError(f"trace {trace_dir} has no segments")
+    rounds_parts, parts = [], {name: [] for name in ARRAY_SPECS}
+    gaps = []
+    prev_end = None
+    for seg in segs:
+        with np.load(os.path.join(trace_dir, seg["file"])) as z:
+            rounds_parts.append(z["rounds"])
+            for name in ARRAY_SPECS:
+                parts[name].append(z[name])
+        if prev_end is not None and seg["start_round"] != prev_end:
+            gaps.append((prev_end, seg["start_round"]))
+        prev_end = seg["end_round"]
+    if gaps:
+        log.warning("WARNING: trace %s has round gap(s): %s", trace_dir,
+                    gaps)
+    rounds = np.concatenate(rounds_parts)
+    arrays = {name: np.concatenate(parts[name]) for name in ARRAY_SPECS}
+    return Trace(manifest, rounds, arrays, gaps=gaps)
+
+
+def validate_trace_manifest(manifest: dict) -> list:
+    """Schema self-check: returns a list of problems (empty == valid)."""
+    problems = []
+    if not isinstance(manifest, dict):
+        return [f"manifest is {type(manifest).__name__}, not dict"]
+    if manifest.get("schema") != TRACE_SCHEMA:
+        problems.append(f"unknown schema: {manifest.get('schema')!r}")
+    for key, types in (("backend", str), ("num_nodes", int),
+                       ("push_fanout", int), ("active_set_size", int),
+                       ("prune_cap", int), ("origins", list),
+                       ("origin_pubkeys", list), ("seed", int),
+                       ("warm_up_rounds", int), ("iterations", int),
+                       ("arrays", dict), ("segments", list),
+                       ("config", dict)):
+        if not isinstance(manifest.get(key), types):
+            problems.append(f"key {key}: missing or not {types.__name__}")
+    for name in ARRAY_SPECS:
+        if name not in (manifest.get("arrays") or {}):
+            problems.append(f"arrays entry missing: {name}")
+    for seg in manifest.get("segments") or []:
+        if (not isinstance(seg, dict) or "file" not in seg
+                or "start_round" not in seg or "end_round" not in seg):
+            problems.append(f"malformed segment entry: {seg!r}")
+        elif seg["end_round"] <= seg["start_round"]:
+            problems.append(f"empty/negative segment range: {seg['file']}")
+    if (isinstance(manifest.get("origins"), list)
+            and isinstance(manifest.get("origin_pubkeys"), list)
+            and len(manifest["origins"]) != len(manifest["origin_pubkeys"])):
+        problems.append("origins / origin_pubkeys length mismatch")
+    try:
+        json.dumps(manifest)
+    except (TypeError, ValueError) as e:
+        problems.append(f"not JSON-serializable: {e}")
+    return problems
+
+
+def validate_trace_dir(trace_dir: str) -> list:
+    """Manifest validation + on-disk segment existence/shape checks."""
+    path = os.path.join(trace_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return [f"no {MANIFEST_NAME} in {trace_dir}"]
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"unreadable manifest: {e}"]
+    problems = validate_trace_manifest(manifest)
+    if problems:
+        return problems
+    n, f_, s, p = (manifest["num_nodes"], manifest["push_fanout"],
+                   manifest["active_set_size"], manifest["prune_cap"])
+    o = len(manifest["origins"])
+    dim = {"N": n, "F": f_, "S": s, "P": p}
+    for seg in manifest["segments"]:
+        fpath = os.path.join(trace_dir, seg["file"])
+        if not os.path.exists(fpath):
+            problems.append(f"segment file missing: {seg['file']}")
+            continue
+        r = seg["end_round"] - seg["start_round"]
+        with np.load(fpath) as z:
+            names = set(z.files)
+            for name, (dtype, dims) in ARRAY_SPECS.items():
+                if name not in names:
+                    problems.append(f"{seg['file']}: missing array {name}")
+                    continue
+                want = (r, o) + tuple(dim[d] for d in dims)
+                if z[name].shape != want:
+                    problems.append(
+                        f"{seg['file']}: {name} shape {z[name].shape} != "
+                        f"{want}")
+                if z[name].dtype != np.dtype(dtype):
+                    problems.append(
+                        f"{seg['file']}: {name} dtype {z[name].dtype} != "
+                        f"{dtype}")
+            if "rounds" not in names:
+                problems.append(f"{seg['file']}: missing rounds axis")
+    return problems

@@ -31,6 +31,8 @@ import logging
 from collections import deque
 
 from ..constants import CRDS_UNIQUE_PUBKEY_CAPACITY, UNREACHED
+from ..obs.trace import (TRACE_CANDIDATE, TRACE_DROPPED, TRACE_FAILED_TARGET,
+                         TRACE_SUPPRESSED)
 from .active_set import PushActiveSet
 from .received_cache import ReceivedCache
 from .rmr import RelativeMessageRedundancy
@@ -85,6 +87,10 @@ class Cluster:
         self.egress_message_count = {}
         self.ingress_message_count = {}
         self.prune_messages_sent = {}
+        # flight recorder (obs/trace.py): when armed (a list, set by
+        # OracleTraceCollector.begin_round), run_gossip appends one
+        # (src, dst, TRACE_* code) event per attempted fanout slot
+        self.edge_log = None
 
     def _clear(self, stakes):
         self.visited.clear()
@@ -124,11 +130,21 @@ class Cluster:
             peers = node.active_set.get_nodes(current, origin_pubkey, stakes)
             for _, neighbor in zip(range(fanout), peers):
                 if node_map[neighbor].failed:
+                    if self.edge_log is not None:
+                        self.edge_log.append(
+                            (current, neighbor, TRACE_FAILED_TARGET))
                     continue  # failed targets consume a fanout slot, nothing else
-                if (impair is not None
-                        and impair.classify_edge(current, neighbor)
-                        != "delivered"):
-                    continue  # suppressed/dropped: slot consumed, no delivery
+                if impair is not None:
+                    outcome = impair.classify_edge(current, neighbor)
+                    if outcome != "delivered":
+                        if self.edge_log is not None:
+                            self.edge_log.append(
+                                (current, neighbor,
+                                 TRACE_SUPPRESSED if outcome == "suppressed"
+                                 else TRACE_DROPPED))
+                        continue  # suppressed/dropped: slot consumed only
+                if self.edge_log is not None:
+                    self.edge_log.append((current, neighbor, TRACE_CANDIDATE))
                 self.pushes[current].add(neighbor)
                 self.egress_message_count[current] += 1
                 self.ingress_message_count[neighbor] = (
@@ -197,10 +213,14 @@ class Cluster:
 
     def chance_to_rotate(self, rng, nodes, active_set_size, stakes,
                          probability_of_rotation):
-        """Bernoulli(p) incremental rotation per node (gossip.rs:739-754)."""
+        """Bernoulli(p) incremental rotation per node (gossip.rs:739-754).
+        Returns the pubkeys that rotated (flight-recorder rotation epochs)."""
+        rotated = []
         for node in nodes:
             if rng.gen_f64() < probability_of_rotation:
                 node.rotate_active_set(rng, active_set_size, stakes)
+                rotated.append(node.pubkey)
+        return rotated
 
     # -- fault injection -----------------------------------------------------
 

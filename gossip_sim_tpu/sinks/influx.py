@@ -284,6 +284,18 @@ class InfluxDataPoint:
             f"queue_depth={queue_depth},iters={iters} ")
         self.append_timestamp()
 
+    def create_sim_trace_point(self, rounds, delivered_edges, prunes,
+                               bytes_written):
+        """Flight-recorder series (obs/trace.py): one point per trace
+        segment flush — rounds captured, delivered edges and prune pairs
+        recorded, and the compressed bytes written to --trace-dir."""
+        self.datapoint += (
+            f"sim_trace,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"rounds={rounds},delivered_edges={delivered_edges},"
+            f"prunes={prunes},bytes_written={bytes_written} ")
+        self.append_timestamp()
+
     def create_messages_point(self, messages_direction: str, messages,
                               simulation_iter_val: int):
         for bucket, count in messages.items():

@@ -1,4 +1,11 @@
-"""Statistics suite (reference: gossip_stats.rs)."""
+"""Statistics suite (reference: gossip_stats.rs).
+
+Per-edge accounting shared with the flight recorder (delivered edges,
+first-delivery trees, redundancy attribution, stranded root-causing) lives
+in :mod:`gossip_sim_tpu.stats.edges`; import it directly — it is left out
+of the package namespace so the stats package stays importable without
+pulling the obs trace schema in.
+"""
 
 from .collections import StatCollection
 from .gossip_stats import GossipStats, GossipStatsCollection, SimulationParameters

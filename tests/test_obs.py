@@ -86,6 +86,52 @@ def test_span_thread_safety():
     assert reg.counter("hits") == n_threads * per_thread
 
 
+def test_spans_concurrent_influx_and_main_not_lost_or_cross_nested():
+    """The production concurrency shape (ISSUE 3): the InfluxThread records
+    sender spans while the main loop records engine/stats spans.  Nothing
+    may be lost (exact counts) and the thread-local span stacks must never
+    cross-nest (each thread always sees exactly its own depth)."""
+    reg = SpanRegistry()
+    n_iters = 400
+    barrier = threading.Barrier(2)
+    depth_errors = []
+
+    def influx_thread():
+        barrier.wait()
+        for _ in range(n_iters):
+            with reg.span("influx/send"):
+                if reg.active_depth() != 1:
+                    depth_errors.append(("influx outer", reg.active_depth()))
+                with reg.span("influx/retry"):
+                    if reg.active_depth() != 2:
+                        depth_errors.append(
+                            ("influx inner", reg.active_depth()))
+                reg.add("points_sent")
+
+    def main_loop():
+        barrier.wait()
+        for _ in range(n_iters):
+            with reg.span("engine/rounds"):
+                with reg.span("stats/harvest"):
+                    if reg.active_depth() != 2:
+                        depth_errors.append(("main inner", reg.active_depth()))
+                if reg.active_depth() != 1:
+                    depth_errors.append(("main outer", reg.active_depth()))
+
+    t = threading.Thread(target=influx_thread)
+    t.start()
+    main_loop()
+    t.join()
+    assert depth_errors == []          # no cross-thread stack bleed
+    for name in ("influx/send", "influx/retry", "engine/rounds",
+                 "stats/harvest"):
+        assert reg.count(name) == n_iters, name   # no lost spans
+    assert reg.counter("points_sent") == n_iters
+    assert reg.active_depth() == 0
+    snap = reg.snapshot()
+    assert all(v["total_s"] >= 0 for v in snap["spans"].values())
+
+
 def test_span_overhead_is_low():
     """The whole point is "cheap enough to leave on": < 50 us per span
     enabled (measured ~1-2 us), and near-free when disabled."""
@@ -219,6 +265,40 @@ def test_heartbeat_zero_progress_eta_unknown():
     hb = Heartbeat(10, interval_s=0.0)
     msg = hb.beat(0)
     assert "ETA ?" in msg
+
+
+def test_heartbeat_first_tick_zero_elapsed_no_div_by_zero():
+    """A beat fired in the same instant the heartbeat was created (elapsed
+    == 0) must not divide by zero and must report ETA '?' — not inf/nan."""
+    hb = Heartbeat(10, interval_s=0.0)
+    hb._t0 = hb._last = time.monotonic() + 3600.0   # force elapsed <= 0
+    msg = hb.beat(0, force=True)
+    assert "0/10" in msg and "0.00" in msg and "ETA ?" in msg
+    assert "inf" not in msg and "nan" not in msg
+
+
+def test_heartbeat_single_step_loop():
+    """total=1: the first beat is also the last — ETA must be 0:00:00 even
+    though no rate is measurable yet, never '?' or negative."""
+    hb = Heartbeat(1, interval_s=0.0)
+    msg = hb.beat(1, force=True)
+    assert "1/1" in msg and "(100.0%)" in msg and "ETA 0:00:00" in msg
+    assert hb.finish() is not None
+
+
+def test_heartbeat_done_clamped_to_total():
+    """done beyond total (a caller overshooting the unit count) clamps
+    instead of reporting >100% or a negative ETA."""
+    hb = Heartbeat(4, interval_s=0.0)
+    time.sleep(0.01)
+    msg = hb.beat(9)
+    assert "4/4" in msg and "(100.0%)" in msg and "ETA 0:00:00" in msg
+
+
+def test_heartbeat_zero_total_never_crashes():
+    hb = Heartbeat(0, interval_s=0.0)
+    msg = hb.finish()
+    assert "0/0" in msg and "ETA ?" in msg
 
 
 # --------------------------------------------------------------------------

@@ -1,0 +1,539 @@
+"""Protocol flight-recorder tests (obs/trace.py, stats/edges.py, ISSUE 3):
+engine trace-row invariants and zero-bit-impact, writer/loader round-trips
+with resume-safe segment merging, oracle-vs-engine trace parity under
+faults, the CLI --trace-dir wiring on every run path, and the
+--trace-dir + --resume composition regression."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                   make_cluster_tables, run_rounds)
+from gossip_sim_tpu.identity import (NodeIndex, get_stake_bucket,
+                                     pubkey_new_unique)
+from gossip_sim_tpu.obs.trace import (ARRAY_SPECS, TRACE_CANDIDATE,
+                                      TRACE_DROPPED, TRACE_SCHEMA,
+                                      OracleTraceCollector, TraceWriter,
+                                      block_from_engine_rows, load_trace,
+                                      validate_trace_dir,
+                                      validate_trace_manifest)
+from gossip_sim_tpu.stats import edges as E
+
+
+def _engine_setup(n=60, seed=3, o=1, **kw):
+    rng = np.random.default_rng(seed)
+    stakes = rng.choice(np.arange(1, 5000), n, replace=False).astype(
+        np.int64) * 10**9
+    tables = make_cluster_tables(stakes)
+    params = EngineParams(num_nodes=n, warm_up_rounds=0, **kw).validate()
+    origins = jnp.arange(o, dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(7), tables, origins, params)
+    return tables, params, origins, state
+
+
+# --------------------------------------------------------------------------
+# stats/edges.py unit tests on crafted arrays
+# --------------------------------------------------------------------------
+
+class TestEdgeAccounting:
+    def test_build_delivery_tree_accepts_consistent_and_rejects_broken(self):
+        dist = np.array([0, 1, 2, -1])
+        first = np.array([-1, 0, 1, -1])
+        parent, ok = E.build_delivery_tree(first, dist, origin=0)
+        assert ok and parent.tolist() == [-1, 0, 1, -1]
+        # wrong hop gap: node 2 claims first delivery from hop-0 node
+        bad = np.array([-1, 0, 0, -1])
+        _, ok = E.build_delivery_tree(bad, dist, origin=0)
+        assert not ok
+        # reached node with no recorded first delivery
+        missing = np.array([-1, 0, -1, -1])
+        _, ok = E.build_delivery_tree(missing, dist, origin=0)
+        assert not ok
+
+    def test_explain_stranded_classifies_every_path(self):
+        # origin 0; node 3 stranded with four distinct failure paths; node 2
+        # stranded with no potential senders at all
+        n, s, f = 5, 2, 2
+        active = np.full((n, s), -1)
+        pruned = np.zeros((n, s), bool)
+        peers = np.full((n, f), -1)
+        code = np.zeros((n, f), np.int8)
+        dist = np.array([0, 1, -1, -1, 1])
+        failed = np.zeros(n, bool)
+        active[0] = [3, 1]      # reached; pushed to 3 but the edge dropped
+        peers[0] = [3, 1]
+        code[0] = [TRACE_DROPPED, TRACE_CANDIDATE]
+        active[1] = [3, -1]     # slot pruned for this origin
+        pruned[1, 0] = True
+        active[2] = [3, -1]     # sender itself unreached
+        active[4] = [3, 0]      # valid slot but fanout-truncated
+        peers[4] = [0, -1]
+        code[4] = [TRACE_CANDIDATE, 0]
+
+        out = E.explain_stranded(active, pruned, peers, code, dist, failed,
+                                 origin=0)
+        by_node = {e["node"]: e for e in out}
+        assert set(by_node) == {2, 3}
+        assert by_node[2]["summary"] == {E.CAUSE_NO_SENDERS: 1}
+        s3 = by_node[3]["summary"]
+        assert s3 == {E.CAUSE_DROPPED: 1, E.CAUSE_PRUNED: 1,
+                      E.CAUSE_SENDER_UNREACHED: 1,
+                      E.CAUSE_FANOUT_TRUNCATED: 1}
+        causes = {(c["sender"], c["cause"]) for c in by_node[3]["causes"]}
+        assert causes == {(0, E.CAUSE_DROPPED), (1, E.CAUSE_PRUNED),
+                          (2, E.CAUSE_SENDER_UNREACHED),
+                          (4, E.CAUSE_FANOUT_TRUNCATED)}
+
+    def test_redundant_edges_and_diff(self):
+        peers = np.array([[1, 2], [2, -1], [-1, -1]])
+        code = np.array([[1, 1], [1, 0], [0, 0]], np.int8)
+        dist = np.array([0, 1, 1])
+        first = np.array([-1, 0, 0])   # 2's first sender is 0, so 1->2 is
+        red = E.redundant_edge_counts(peers, code, dist, first, 3)
+        assert red == {(1, 2): 1}
+        d = E.diff_delivered(peers, code, dist,
+                             peers, np.zeros_like(code), dist, 3)
+        assert d["n_a"] == 3 and d["n_b"] == 0 and len(d["only_a"]) == 3
+
+
+# --------------------------------------------------------------------------
+# engine trace rows
+# --------------------------------------------------------------------------
+
+class TestEngineTraceRows:
+    ROUNDS = 30   # long enough to cross the min_num_upserts prune threshold
+
+    @pytest.fixture(scope="class")
+    def traced(self):
+        tables, params, origins, state = _engine_setup(o=2)
+        state, rows = run_rounds(params, tables, origins, state, self.ROUNDS,
+                                 detail=True, trace=True)
+        return params, jax.tree_util.tree_map(np.asarray, rows)
+
+    def test_trace_flag_changes_no_simulation_bits(self):
+        tables, params, origins, state = _engine_setup(o=2)
+        s1, r1 = run_rounds(params, tables, origins, state, 6, detail=True,
+                            trace=True)
+        tables, params, origins, state = _engine_setup(o=2)
+        s2, r2 = run_rounds(params, tables, origins, state, 6, detail=True)
+        r1 = jax.tree_util.tree_map(np.asarray, r1)
+        r2 = jax.tree_util.tree_map(np.asarray, r2)
+        for k in r2:
+            np.testing.assert_array_equal(r1[k], r2[k], err_msg=k)
+        for f in s2._fields:
+            np.testing.assert_array_equal(np.asarray(getattr(s1, f)),
+                                          np.asarray(getattr(s2, f)),
+                                          err_msg=f)
+
+    def test_first_delivery_and_tree(self, traced):
+        _, rows = traced
+        for r in range(self.ROUNDS):
+            for col in range(2):
+                dist = rows["dist"][r, col]
+                first = rows["trace_first"][r, col]
+                m = dist > 0
+                assert (first[m] >= 0).all()
+                assert (dist[first[m]] + 1 == dist[m]).all()
+                origin = col  # origins were arange(2)
+                _, ok = E.build_delivery_tree(first, dist, origin)
+                assert ok, (r, col)
+                # the shared edge-list form: one row per reached non-origin
+                # node, hop == receiver distance, sender one hop closer
+                fd = E.first_delivery_edges(first, dist)
+                assert fd.shape[0] == int(m.sum())
+                assert (fd[:, 2] == dist[fd[:, 1]]).all()
+                assert (dist[fd[:, 0]] + 1 == fd[:, 2]).all()
+
+    def test_delivered_edges_match_m_and_coverage(self, traced):
+        _, rows = traced
+        for r in range(self.ROUNDS):
+            for col in range(2):
+                dist = rows["dist"][r, col]
+                edges = E.delivered_edges(rows["trace_peers"][r, col],
+                                          rows["trace_code"][r, col], dist)
+                assert edges.shape[0] == rows["delivered"][r, col]
+                # delivered targets are reached
+                assert (dist[edges[:, 1]] >= 0).all()
+
+    def test_prune_pairs_match_prunes_sent(self, traced):
+        _, rows = traced
+        total = 0
+        for r in range(self.ROUNDS):
+            for col in range(2):
+                pairs = (rows["trace_prune_src"][r, col] >= 0).sum()
+                assert pairs == rows["prunes_sent"][r, col]
+                total += int(pairs)
+        assert total > 0, "run too short to exercise prune capture"
+
+    def test_rotation_events_recorded(self, traced):
+        _, rows = traced
+        rot = rows["trace_rot"]
+        assert (rot >= -1).all()
+        assert (rot >= 0).any(), "no rotation event in 30 rounds"
+        # a rotation event's peer lands in the newest slot of the next
+        # round's active snapshot (full rows shift left)
+        act = rows["trace_active"]
+        for r in range(self.ROUNDS - 1):
+            o_idx, n_idx = np.nonzero(rot[r] >= 0)
+            for o, nd in zip(o_idx, n_idx):
+                assert rot[r, o, nd] in act[r + 1, o, nd]
+
+    def test_prune_capture_truncation_is_flagged(self, tmp_path):
+        """A tiny trace_prune_cap forces truncation; the writer must flag
+        the affected rounds in the manifest instead of dropping silently."""
+        tables, params, origins, state = _engine_setup(
+            o=1, trace_prune_cap=1)
+        state, rows = run_rounds(params, tables, origins, state, self.ROUNDS,
+                                 detail=True, trace=True)
+        rows = jax.tree_util.tree_map(np.asarray, rows)
+        assert (rows["prunes_sent"] > 1).any(), "need a >1-prune round"
+        w = TraceWriter(str(tmp_path), backend="tpu",
+                        num_nodes=params.num_nodes,
+                        push_fanout=params.push_fanout,
+                        active_set_size=params.active_set_size,
+                        prune_cap=params.prune_cap, origins=[0],
+                        origin_pubkeys=["o"], seed=0, warm_up_rounds=0,
+                        iterations=self.ROUNDS)
+        seg = w.add_block(0, block_from_engine_rows(rows))
+        assert seg["truncated_prune_rounds"], "truncation not flagged"
+
+
+# --------------------------------------------------------------------------
+# writer / loader
+# --------------------------------------------------------------------------
+
+class TestWriterLoader:
+    def _write(self, tmp_path, rounds=8, start=0, n=40):
+        tables, params, origins, state = _engine_setup(n=n, o=1)
+        state, rows = run_rounds(params, tables, origins, state,
+                                 rounds + start, detail=True, trace=True)
+        rows = jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[start:], rows)
+        w = TraceWriter(str(tmp_path), backend="tpu", num_nodes=n,
+                        push_fanout=params.push_fanout,
+                        active_set_size=params.active_set_size,
+                        prune_cap=params.prune_cap, origins=[0],
+                        origin_pubkeys=["pk0"], seed=7,
+                        warm_up_rounds=start, iterations=rounds + start)
+        return w, block_from_engine_rows(rows)
+
+    def test_round_trip_and_validation(self, tmp_path):
+        w, block = self._write(tmp_path)
+        w.add_block(0, {k: v[:4] for k, v in block.items()})
+        w.add_block(4, {k: v[4:] for k, v in block.items()})
+        m = w.finalize()
+        assert m["schema"] == TRACE_SCHEMA
+        assert validate_trace_manifest(m) == []
+        assert validate_trace_dir(str(tmp_path)) == []
+        tr = load_trace(str(tmp_path))
+        assert len(tr) == 8 and tr.rounds.tolist() == list(range(8))
+        assert not tr.gaps
+        for name in ARRAY_SPECS:
+            np.testing.assert_array_equal(
+                tr.arrays[name],
+                block[name].astype(tr.arrays[name].dtype), err_msg=name)
+        # convenience accessors
+        assert tr.col_of(0) == 0
+        assert set(tr.at(3)) == set(ARRAY_SPECS)
+        with pytest.raises(KeyError):
+            tr.pos_of(99)
+
+    def test_overlapping_segment_replaced_not_duplicated(self, tmp_path):
+        w, block = self._write(tmp_path)
+        w.add_block(0, {k: v[:6] for k, v in block.items()})
+        # a resume re-running the same block overwrites, never duplicates
+        w.add_block(0, {k: v[:6] for k, v in block.items()})
+        assert len(w.manifest["segments"]) == 1
+        # a partially-overlapping rewrite replaces the stale segment (the
+        # new capture wins; no round is ever present twice)
+        w.add_block(2, {k: v[2:] for k, v in block.items()})
+        assert len(w.manifest["segments"]) == 1
+        tr = load_trace(str(tmp_path))
+        assert tr.rounds.tolist() == list(range(2, 8))
+        counts = np.bincount(tr.rounds)
+        assert (counts[counts > 0] == 1).all()
+
+    def test_mismatched_manifest_replaced(self, tmp_path):
+        w, block = self._write(tmp_path)
+        w.add_block(0, block)
+        # same dir, different seed -> prior segments must not be merged
+        w2 = TraceWriter(str(tmp_path), backend="tpu", num_nodes=40,
+                         push_fanout=6, active_set_size=12,
+                         prune_cap=80, origins=[0], origin_pubkeys=["pk0"],
+                         seed=99, warm_up_rounds=0, iterations=8)
+        assert w2.manifest["segments"] == []
+
+    def test_writer_rejects_clusters_beyond_int16_ids(self, tmp_path):
+        """Node ids are stored int16; the engine shares the 32767 cap but
+        the oracle has none, so the writer must refuse rather than let ids
+        wrap into sentinel space."""
+        with pytest.raises(ValueError, match="int16"):
+            TraceWriter(str(tmp_path), backend="oracle", num_nodes=40000,
+                        push_fanout=6, active_set_size=12, prune_cap=100,
+                        origins=[0], origin_pubkeys=["pk0"], seed=0,
+                        warm_up_rounds=0, iterations=1)
+
+    def test_validation_catches_corruption(self, tmp_path):
+        w, block = self._write(tmp_path)
+        w.add_block(0, block)
+        w.finalize()
+        m_path = os.path.join(str(tmp_path), "manifest.json")
+        with open(m_path) as f:
+            m = json.load(f)
+        seg_file = m["segments"][0]["file"]
+        os.unlink(os.path.join(str(tmp_path), seg_file))
+        assert any("missing" in p for p in validate_trace_dir(str(tmp_path)))
+        m["schema"] = "bogus"
+        assert any("schema" in p for p in validate_trace_manifest(m))
+
+
+# --------------------------------------------------------------------------
+# oracle-vs-engine trace parity (forced active sets, under faults)
+# --------------------------------------------------------------------------
+
+class TestOracleEngineTraceParity:
+    """With the oracle's active sets forced to the engine's sampled ones
+    and rotation off, both backends' flight recorders must log identical
+    distances, first-delivery senders, delivered edge sets and prune pairs
+    — including under packet loss + churn + a partition, which exercises
+    every outcome code."""
+
+    N = 256
+    ROUNDS = 26   # past min_num_upserts so prune pairs get compared too
+    SEED = 21
+    KNOBS = dict(packet_loss_rate=0.15, churn_fail_rate=0.02,
+                 churn_recover_rate=0.25, partition_at=2, heal_at=5)
+
+    def test_trace_parity_under_faults(self):
+        from gossip_sim_tpu.faults import FaultInjector
+        from gossip_sim_tpu.oracle.cluster import Cluster, Node
+
+        n = self.N
+        rng = np.random.default_rng(17)
+        stakes_arr = rng.choice(np.arange(1, 50 * n), size=n,
+                                replace=False).astype(np.int64) * 10**9
+        accounts = {pubkey_new_unique(): int(s) for s in stakes_arr}
+        index = NodeIndex.from_stakes(accounts)
+        stakes_np = index.stakes.astype(np.int64)
+        tables = make_cluster_tables(stakes_np)
+        params = EngineParams(num_nodes=n, probability_of_rotation=0.0,
+                              warm_up_rounds=0, impair_seed=self.SEED,
+                              **self.KNOBS).validate()
+        origins = jnp.asarray([0], jnp.int32)
+        state = init_state(jax.random.PRNGKey(11), tables, origins, params)
+
+        stakes_map = {pk: int(s) for pk, s in zip(index.pubkeys, stakes_np)}
+        nodes = [Node(pk, stakes_map[pk]) for pk in index.pubkeys]
+        origin_pk = index.pubkeys[0]
+        active = np.asarray(state.active[0])
+        for i, node in enumerate(nodes):
+            bucket = get_stake_bucket(min(stakes_map[node.pubkey],
+                                          stakes_map[origin_pk]))
+            node.active_set.entries[bucket].peers = {
+                index.pubkeys[j]: {index.pubkeys[j]}
+                for j in active[i] if j < n}
+        node_map = {nd.pubkey: nd for nd in nodes}
+        cluster = Cluster(params.push_fanout)
+        impair = FaultInjector(index, seed=self.SEED, **self.KNOBS)
+        collector = OracleTraceCollector(
+            index, origin_pk, push_fanout=params.push_fanout,
+            active_set_size=params.active_set_size,
+            prune_cap=params.prune_cap)
+
+        state, rows = run_rounds(params, tables, origins, state, self.ROUNDS,
+                                 trace=True)
+        rows = jax.tree_util.tree_map(np.asarray, rows)
+        for r in range(self.ROUNDS):
+            impair.begin_round(r)
+            impair.churn_step(r, node_map, cluster.failed_nodes)
+            collector.begin_round(cluster, node_map)
+            cluster.run_gossip(origin_pk, stakes_map, node_map, impair)
+            cluster.consume_messages(origin_pk, nodes)
+            cluster.send_prunes(origin_pk, nodes,
+                                params.prune_stake_threshold,
+                                params.min_ingress_nodes, stakes_map)
+            cluster.prune_connections(node_map, stakes_map)
+            collector.end_round(r, cluster, node_map, [])
+        start, block = collector.flush()
+        assert start == 0
+
+        saw_drop = saw_prune = False
+        for r in range(self.ROUNDS):
+            dist_e, dist_o = rows["dist"][r, 0], block["dist"][r, 0]
+            np.testing.assert_array_equal(dist_e, dist_o,
+                                          err_msg=f"dist round {r}")
+            np.testing.assert_array_equal(
+                rows["trace_first"][r, 0], block["first_src"][r, 0],
+                err_msg=f"first_src round {r}")
+            np.testing.assert_array_equal(
+                rows["failed_mask"][r, 0], block["failed"][r, 0],
+                err_msg=f"failed round {r}")
+            edges_e = E.delivered_edges(rows["trace_peers"][r, 0],
+                                        rows["trace_code"][r, 0], dist_e)
+            edges_o = E.delivered_edges(block["peers"][r, 0],
+                                        block["code"][r, 0], dist_o)
+            assert (set(E.edge_keys(edges_e, n).tolist())
+                    == set(E.edge_keys(edges_o, n).tolist())), r
+            saw_drop |= bool((rows["trace_code"][r, 0] == TRACE_DROPPED)
+                             .any())
+            pairs_e = {(int(s), int(d)) for s, d in zip(
+                rows["trace_prune_src"][r, 0], rows["trace_prune_dst"][r, 0])
+                if s >= 0}
+            pairs_o = {(int(s), int(d)) for s, d in zip(
+                block["prune_src"][r, 0], block["prune_dst"][r, 0])
+                if s >= 0}
+            assert pairs_e == pairs_o, f"prune pairs diverge round {r}"
+            saw_prune |= bool(pairs_e)
+        assert saw_drop, "loss regime never exercised the dropped code"
+        assert saw_prune, "run too short to compare prune pairs"
+
+
+# --------------------------------------------------------------------------
+# CLI wiring + resume composition
+# --------------------------------------------------------------------------
+
+class TestCliTrace:
+    N = 40
+    BASE = ["--num-synthetic-nodes", "40", "--seed", "7"]
+
+    def _main(self, extra):
+        from gossip_sim_tpu.cli import main
+        return main(self.BASE + extra)
+
+    def test_tpu_trace_end_to_end(self, tmp_path):
+        d = str(tmp_path / "trace")
+        rc = self._main(["--iterations", "12", "--warm-up-rounds", "4",
+                         "--trace-dir", d])
+        assert rc == 0
+        assert validate_trace_dir(d) == []
+        tr = load_trace(d)
+        assert tr.manifest["backend"] == "tpu"
+        assert len(tr) == 8 and int(tr.rounds[0]) == 4
+        origin = tr.origins[0]
+        for t in range(len(tr)):
+            _, ok = E.build_delivery_tree(tr.arrays["first_src"][t, 0],
+                                          tr.arrays["dist"][t, 0], origin)
+            assert ok
+            stranded = int(((tr.arrays["dist"][t, 0] < 0)
+                            & ~tr.arrays["failed"][t, 0]).sum())
+            expl = E.explain_stranded(
+                tr.arrays["active"][t, 0], tr.arrays["pruned"][t, 0],
+                tr.arrays["peers"][t, 0], tr.arrays["code"][t, 0],
+                tr.arrays["dist"][t, 0], tr.arrays["failed"][t, 0], origin)
+            assert len(expl) == stranded
+
+    def test_oracle_trace_end_to_end(self, tmp_path):
+        d = str(tmp_path / "trace")
+        rc = self._main(["--iterations", "8", "--warm-up-rounds", "2",
+                         "--backend", "oracle", "--trace-dir", d])
+        assert rc == 0
+        assert validate_trace_dir(d) == []
+        tr = load_trace(d)
+        assert tr.manifest["backend"] == "oracle"
+        assert len(tr) == 6
+        for t in range(len(tr)):
+            _, ok = E.build_delivery_tree(tr.arrays["first_src"][t, 0],
+                                          tr.arrays["dist"][t, 0],
+                                          tr.origins[0])
+            assert ok
+
+    def test_trace_composes_with_resume(self, tmp_path):
+        """Regression (ISSUE 3 satellite): a checkpoint restart must append
+        the remaining rounds to the trace without duplicating or losing
+        rounds already traced — the stitched trace equals the full run's."""
+        from gossip_sim_tpu.identity import reset_unique_pubkeys
+
+        full = str(tmp_path / "full")
+        split = str(tmp_path / "split")
+        ck = str(tmp_path / "ck.npz")
+        # the synthetic cluster draws from the process-global unique-pubkey
+        # counter: reset before each run so all three see the same cluster
+        reset_unique_pubkeys()
+        rc = self._main(["--iterations", "12", "--warm-up-rounds", "2",
+                         "--trace-dir", full])
+        assert rc == 0
+        reset_unique_pubkeys()
+        rc = self._main(["--iterations", "7", "--warm-up-rounds", "2",
+                         "--trace-dir", split, "--checkpoint-path", ck])
+        assert rc == 0
+        reset_unique_pubkeys()
+        rc = self._main(["--iterations", "12", "--warm-up-rounds", "2",
+                         "--trace-dir", split, "--resume", ck])
+        assert rc == 0
+        a, b = load_trace(full), load_trace(split)
+        assert len(b.manifest["segments"]) == 2
+        assert not b.gaps
+        np.testing.assert_array_equal(a.rounds, b.rounds)
+        for name in ARRAY_SPECS:
+            np.testing.assert_array_equal(a.arrays[name], b.arrays[name],
+                                          err_msg=name)
+
+    def test_batched_origin_rank_sweep_traces_all_columns(self, tmp_path):
+        d = str(tmp_path / "trace")
+        rc = self._main(["--iterations", "8", "--warm-up-rounds", "2",
+                         "--test-type", "origin-rank",
+                         "--num-simulations", "2", "--origin-rank", "1", "3",
+                         "--trace-dir", d])
+        assert rc == 0
+        assert validate_trace_dir(d) == []
+        tr = load_trace(d)
+        assert len(tr.origins) == 2
+        for col, origin in enumerate(tr.origins):
+            for t in range(len(tr)):
+                _, ok = E.build_delivery_tree(
+                    tr.arrays["first_src"][t, col],
+                    tr.arrays["dist"][t, col], origin)
+                assert ok, (t, col)
+
+    def test_generic_sweep_writes_per_sim_subdirs(self, tmp_path):
+        d = str(tmp_path / "trace")
+        rc = self._main(["--iterations", "6", "--warm-up-rounds", "2",
+                         "--test-type", "rotate-probability",
+                         "--num-simulations", "2", "--step-size", "0.1",
+                         "--trace-dir", d])
+        assert rc == 0
+        for sub in ("sim000", "sim001"):
+            assert validate_trace_dir(os.path.join(d, sub)) == []
+
+    def test_all_origins_traces_sampled_origins(self, tmp_path):
+        d = str(tmp_path / "trace")
+        rc = self._main(["--iterations", "6", "--warm-up-rounds", "2",
+                         "--all-origins", "--trace-origins", "2",
+                         "--trace-dir", d])
+        assert rc == 0
+        assert validate_trace_dir(d) == []
+        tr = load_trace(d)
+        assert tr.origins == [0, 1] and len(tr) == 4
+        for col, origin in enumerate(tr.origins):
+            for t in range(len(tr)):
+                _, ok = E.build_delivery_tree(
+                    tr.arrays["first_src"][t, col],
+                    tr.arrays["dist"][t, col], origin)
+                assert ok, (t, col)
+
+    def test_trace_flags_parse_into_config(self):
+        from gossip_sim_tpu.cli import build_parser, config_from_args
+
+        cfg = config_from_args(build_parser().parse_args(
+            ["--trace-dir", "/tmp/t", "--trace-origins", "2",
+             "--trace-prune-cap", "512"]))
+        assert cfg.trace_dir == "/tmp/t"
+        assert cfg.trace_origins == 2
+        assert cfg.trace_prune_cap == 512
+        # the cap reaches the engine: EngineParams resolves it verbatim
+        assert EngineParams(num_nodes=100,
+                            trace_prune_cap=512).prune_cap == 512
+        assert EngineParams(num_nodes=100).prune_cap == 1600
+
+    def test_no_measured_rounds_warns_and_writes_nothing(self, tmp_path,
+                                                         caplog):
+        d = str(tmp_path / "trace")
+        rc = self._main(["--iterations", "3", "--warm-up-rounds", "5",
+                         "--trace-dir", d])
+        assert rc == 0
+        assert not os.path.exists(os.path.join(d, "manifest.json"))

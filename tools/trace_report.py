@@ -1,0 +1,321 @@
+"""Offline analysis of protocol flight-recorder traces (obs/trace.py).
+
+Subcommands over a ``--trace-dir`` capture (schema gossip-sim-tpu/trace/v1):
+
+  info DIR                      manifest summary + on-disk validation
+  tree DIR [--round R]          reconstruct + render the delivery tree
+  explain-stranded DIR [...]    root-cause every stranded node of a round
+  attribute-rmr DIR [--top K]   top-K redundant edges behind the RMR
+  diff DIR_A DIR_B [...]        edge-by-edge delivered-set diff of two traces
+
+Shared flags: ``--round R`` (absolute round index; default = last traced),
+``--col C`` (origin column for multi-origin traces; default 0), ``--json``
+(machine-readable output where supported).
+
+Examples:
+
+  python tools/trace_report.py info /tmp/trace
+  python tools/trace_report.py tree /tmp/trace --round 210
+  python tools/trace_report.py explain-stranded /tmp/trace --json
+  python tools/trace_report.py attribute-rmr /tmp/trace --top 10
+  python tools/trace_report.py diff /tmp/base /tmp/loss --top 5
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_sim_tpu.obs.trace import (load_trace,  # noqa: E402
+                                      validate_trace_dir)
+from gossip_sim_tpu.stats import edges as E  # noqa: E402
+
+
+def _round_and_col(trace, args):
+    rnd = args.round if args.round is not None else int(trace.rounds[-1])
+    col = args.col
+    if not 0 <= col < len(trace.origins):
+        raise SystemExit(f"--col {col} out of range (trace has "
+                         f"{len(trace.origins)} origin column(s))")
+    return rnd, col
+
+
+def _round_slice(trace, rnd, col):
+    at = trace.at(rnd)
+    return {name: arr[col] for name, arr in at.items()}
+
+
+# --------------------------------------------------------------------------
+# info
+# --------------------------------------------------------------------------
+
+def cmd_info(args) -> int:
+    problems = validate_trace_dir(args.trace_dir)
+    if problems:
+        print(f"INVALID trace in {args.trace_dir}:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    tr = load_trace(args.trace_dir)
+    m = tr.manifest
+    print(f"trace: {args.trace_dir}  [{m['schema']}]  VALID")
+    print(f"  backend={m['backend']} num_nodes={m['num_nodes']} "
+          f"fanout={m['push_fanout']} active_set={m['active_set_size']} "
+          f"seed={m['seed']}")
+    print(f"  origins ({len(m['origins'])}): {m['origins']}")
+    print(f"  rounds traced: {len(tr)} "
+          f"[{int(tr.rounds[0])}..{int(tr.rounds[-1])}] in "
+          f"{len(m['segments'])} segment(s)"
+          + (f"  GAPS: {tr.gaps}" if tr.gaps else ""))
+    cov = tr.arrays["coverage"]
+    dist = tr.arrays["dist"]
+    failed = tr.arrays["failed"]
+    stranded = ((dist < 0) & ~failed).sum(axis=-1)      # [T, O]
+    print(f"  coverage mean={cov.mean():.6f} min={cov.min():.6f}; "
+          f"stranded mean/round={stranded.mean():.2f} "
+          f"max={int(stranded.max())}")
+    trunc = [r for seg in m["segments"]
+             for r in seg.get("truncated_prune_rounds", [])]
+    if trunc:
+        print(f"  WARNING: prune capture truncated in round(s) {trunc}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# tree
+# --------------------------------------------------------------------------
+
+def cmd_tree(args) -> int:
+    tr = load_trace(args.trace_dir)
+    rnd, col = _round_and_col(tr, args)
+    origin = tr.origins[col]
+    s = _round_slice(tr, rnd, col)
+    parent, ok = E.build_delivery_tree(s["first_src"], s["dist"], origin)
+    dist = s["dist"]
+    reached = dist >= 0
+    print(f"delivery tree: round {rnd}, origin {origin} "
+          f"({int(reached.sum())}/{tr.num_nodes} reached, "
+          f"root {'OK' if ok else 'BROKEN'})")
+    depth_counts = np.bincount(dist[reached])
+    for h, c in enumerate(depth_counts):
+        print(f"  hop {h}: {int(c)} node(s)")
+    if not ok:
+        print("  ERROR: recorded first deliveries do not form a tree "
+              "rooted at the origin")
+        return 1
+    children = {}
+    for n in np.nonzero(parent >= 0)[0]:
+        children.setdefault(int(parent[n]), []).append(int(n))
+    lines = []
+
+    def walk(node, depth):
+        if len(lines) >= args.max_nodes:
+            return
+        lines.append("  " + "  " * depth + f"{node} (hop {int(dist[node])})")
+        for c in sorted(children.get(node, [])):
+            walk(c, depth + 1)
+
+    walk(int(origin), 0)
+    print("\n".join(lines))
+    if len(lines) >= args.max_nodes:
+        print(f"  ... truncated at --max-nodes {args.max_nodes}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# explain-stranded
+# --------------------------------------------------------------------------
+
+def cmd_explain_stranded(args) -> int:
+    tr = load_trace(args.trace_dir)
+    rnd, col = _round_and_col(tr, args)
+    origin = tr.origins[col]
+    s = _round_slice(tr, rnd, col)
+    explained = E.explain_stranded(s["active"], s["pruned"], s["peers"],
+                                   s["code"], s["dist"], s["failed"], origin)
+    if args.json:
+        print(json.dumps({"round": rnd, "origin": origin,
+                          "stranded": explained}, indent=2))
+        return 0
+    print(f"stranded nodes: round {rnd}, origin {origin} -> "
+          f"{len(explained)} stranded")
+    for ent in explained:
+        causes = ent["summary"]
+        top = ", ".join(f"{k}={v}" for k, v in
+                        sorted(causes.items(), key=lambda kv: -kv[1]))
+        print(f"  node {ent['node']}: {top}")
+        if args.verbose:
+            for c in ent["causes"]:
+                print(f"    sender {c['sender']} slot {c['slot']}: "
+                      f"{c['cause']}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# attribute-rmr
+# --------------------------------------------------------------------------
+
+def cmd_attribute_rmr(args) -> int:
+    tr = load_trace(args.trace_dir)
+    _, col = _round_and_col(tr, args)
+    # --round restricts attribution to one round; default = all traced
+    positions = ([tr.pos_of(args.round)] if args.round is not None
+                 else range(len(tr)))
+    n = tr.num_nodes
+    totals = {}
+    total_delivered = total_redundant = total_prunes = 0
+    for t in positions:
+        peers = tr.arrays["peers"][t, col]
+        code = tr.arrays["code"][t, col]
+        dist = tr.arrays["dist"][t, col]
+        first = tr.arrays["first_src"][t, col]
+        total_delivered += E.delivered_edges(peers, code, dist).shape[0]
+        total_prunes += int(tr.arrays["prunes_total"][t, col])
+        for edge, c in E.redundant_edge_counts(peers, code, dist, first,
+                                               n).items():
+            totals[edge] = totals.get(edge, 0) + c
+            total_redundant += c
+    top = sorted(totals.items(), key=lambda kv: -kv[1])[:args.top]
+    if args.json:   # machine-readable only, like explain-stranded
+        print(json.dumps({"rounds": len(list(positions)),
+                          "origin": tr.origins[col],
+                          "delivered": total_delivered,
+                          "redundant": total_redundant,
+                          "prunes": total_prunes,
+                          "top": [{"src": s_, "dst": d, "count": c}
+                                  for (s_, d), c in top]}, indent=2))
+        return 0
+    print(f"RMR attribution over {len(list(positions))} traced round(s), "
+          f"origin {tr.origins[col]}:")
+    print(f"  delivered={total_delivered} redundant={total_redundant} "
+          f"prune_messages={total_prunes}")
+    print(f"  (RMR's numerator m = delivered + prunes; redundancy = "
+          f"deliveries beyond each receiver's first)")
+    print(f"  top {len(top)} redundant edges (src -> dst: rounds redundant):")
+    for (src, dst), c in top:
+        print(f"    {src} -> {dst}: {c}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# diff
+# --------------------------------------------------------------------------
+
+def cmd_diff(args) -> int:
+    a = load_trace(args.trace_dir)
+    b = load_trace(args.trace_dir_b)
+    if a.num_nodes != b.num_nodes:
+        raise SystemExit(f"traces disagree on num_nodes: {a.num_nodes} vs "
+                         f"{b.num_nodes}")
+    _, col = _round_and_col(a, args)
+    if not 0 <= col < len(b.origins):
+        raise SystemExit(f"--col {col} out of range for trace B "
+                         f"({len(b.origins)} origin column(s))")
+    if a.origins[col] != b.origins[col]:
+        raise SystemExit(
+            f"column {col} records different origins: {a.origins[col]} (A) "
+            f"vs {b.origins[col]} (B) — diffing them would compare "
+            f"unrelated simulations")
+    common_rounds = sorted(set(a.rounds.tolist()) & set(b.rounds.tolist()))
+    if args.round is not None:
+        if args.round not in common_rounds:
+            raise SystemExit(f"round {args.round} is not traced by both")
+        common_rounds = [args.round]
+    if not common_rounds:
+        raise SystemExit("traces share no rounds")
+    n = a.num_nodes
+    only_a = only_b = shared = 0
+    edge_delta = {}
+    cov_delta = []
+    for rnd in common_rounds:
+        sa, sb = _round_slice(a, rnd, col), _round_slice(b, rnd, col)
+        d = E.diff_delivered(sa["peers"], sa["code"], sa["dist"],
+                             sb["peers"], sb["code"], sb["dist"], n)
+        shared += len(d["common"])
+        only_a += len(d["only_a"])
+        only_b += len(d["only_b"])
+        for k in d["only_a"]:
+            edge_delta[k] = edge_delta.get(k, 0) + 1
+        for k in d["only_b"]:
+            edge_delta[k] = edge_delta.get(k, 0) - 1
+        cov_delta.append(float(sa["coverage"]) - float(sb["coverage"]))
+    top = sorted(edge_delta.items(), key=lambda kv: -abs(kv[1]))[:args.top]
+    if args.json:
+        print(json.dumps({
+            "rounds": len(common_rounds), "col": col,
+            "shared": shared, "only_a": only_a, "only_b": only_b,
+            "coverage_delta_mean": float(np.mean(cov_delta)),
+            "top": [{"src": E.unpack_edge(k, n)[0],
+                     "dst": E.unpack_edge(k, n)[1], "delta": c}
+                    for k, c in top]}, indent=2))
+        return 0
+    print(f"trace diff over {len(common_rounds)} shared round(s), origin "
+          f"column {col}:")
+    print(f"  delivered edges: shared={shared} only_A={only_a} "
+          f"only_B={only_b}")
+    print(f"  coverage delta (A - B): mean {np.mean(cov_delta):+.6f}, "
+          f"max |{np.max(np.abs(cov_delta)):.6f}|")
+    print(f"  top {len(top)} differing edges (src -> dst: rounds_only_A - "
+          f"rounds_only_B):")
+    for k, c in top:
+        src, dst = E.unpack_edge(k, n)
+        print(f"    {src} -> {dst}: {c:+d}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_report",
+        description="analyze protocol flight-recorder traces "
+                    "(gossip-sim-tpu/trace/v1)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p, b_dir=False):
+        p.add_argument("trace_dir", help="--trace-dir of a recorded run")
+        if b_dir:
+            p.add_argument("trace_dir_b", help="second trace to diff against")
+        p.add_argument("--round", type=int, default=None,
+                       help="absolute round index (default: last traced)")
+        p.add_argument("--col", type=int, default=0,
+                       help="origin column for multi-origin traces")
+        p.add_argument("--json", action="store_true")
+
+    common(sub.add_parser("info", help="manifest summary + validation"))
+    p = sub.add_parser("tree", help="render the delivery tree of a round")
+    common(p)
+    p.add_argument("--max-nodes", type=int, default=200,
+                   help="cap on rendered tree lines")
+    p = sub.add_parser("explain-stranded",
+                       help="root-cause every stranded node of a round")
+    common(p)
+    p.add_argument("--verbose", action="store_true",
+                   help="list every (sender, slot, cause) path")
+    p = sub.add_parser("attribute-rmr",
+                       help="top-K redundant edges across traced rounds")
+    common(p)
+    p.add_argument("--top", type=int, default=10)
+    p = sub.add_parser("diff", help="edge-by-edge diff of two traces")
+    common(p, b_dir=True)
+    p.add_argument("--top", type=int, default=10)
+
+    args = ap.parse_args(argv)
+    try:
+        return {
+            "info": cmd_info,
+            "tree": cmd_tree,
+            "explain-stranded": cmd_explain_stranded,
+            "attribute-rmr": cmd_attribute_rmr,
+            "diff": cmd_diff,
+        }[args.cmd](args)
+    except BrokenPipeError:    # output piped into head/less and closed
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

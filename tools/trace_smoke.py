@@ -1,0 +1,269 @@
+"""Flight-recorder smoke gate: 1k-node CPU sim with ``--trace-dir``,
+schema + delivery-tree + stranded-explanation assertions, bit-parity and
+a tracing-overhead budget, plus engine-vs-oracle first-delivery parity.
+
+Fast CI gate (CPU, well under 60 s):
+
+  1. one cold run to populate the in-process jit cache (untimed),
+  2. best-of-N timed runs with ``--run-report`` only,
+  3. best-of-N timed runs with ``--run-report`` + ``--trace-dir``,
+  4. assertions:
+       * the trace manifest validates (gossip-sim-tpu/trace/v1) and loads,
+       * every traced round's first deliveries form a tree rooted at the
+         origin,
+       * the trace's covered-node counts match the stats layer (per-round
+         vs the recorded coverage; mean vs the run report),
+       * every stranded node gets a concrete cause from explain_stranded,
+       * enabling tracing changes no simulation output bits (identical
+         coverage_mean / rmr_mean in the run reports),
+       * tracing overhead stays under ``--overhead-budget`` (default 5%)
+         plus an absolute slack absorbing CI timer noise,
+  5. engine-vs-oracle parity (``--skip-parity`` to skip): with the
+     oracle's active sets forced to the engine's sampled ones and rotation
+     off, both backends' traces must record identical distances,
+     first-delivery edge sets and delivered edge sets every round — under
+     packet loss, so the outcome codes are exercised too.
+
+Usage: python tools/trace_smoke.py [--num-nodes 1000] [--iterations 20]
+       [--warm-up-rounds 4] [--seed 7] [--reps 2]
+       [--overhead-budget 0.05] [--overhead-slack-s 0.5] [--skip-parity]
+
+Exit code 0 = all assertions hold; 1 = a flight-recorder invariant failed.
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="flight-recorder schema + parity + overhead smoke "
+                    "(CPU, <60s)")
+    ap.add_argument("--num-nodes", type=int, default=1000)
+    ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--warm-up-rounds", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--reps", type=int, default=2,
+                    help="timed repetitions per arm (best-of)")
+    ap.add_argument("--overhead-budget", type=float, default=0.05,
+                    help="max fractional tracing overhead (default 5%%)")
+    ap.add_argument("--overhead-slack-s", type=float, default=0.5,
+                    help="absolute slack absorbing timer noise on "
+                         "sub-second runs")
+    ap.add_argument("--skip-parity", action="store_true",
+                    help="skip the engine-vs-oracle trace parity check")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from gossip_sim_tpu.cli import main as cli_main
+    from gossip_sim_tpu.obs.trace import load_trace, validate_trace_dir
+    from gossip_sim_tpu.stats import edges as E
+
+    base = ["--num-synthetic-nodes", str(args.num_nodes),
+            "--iterations", str(args.iterations),
+            "--warm-up-rounds", str(args.warm_up_rounds),
+            "--seed", str(args.seed)]
+    tmp = f"/tmp/trace_smoke_{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    def timed_run(extra):
+        t0 = time.perf_counter()
+        rc = cli_main(base + extra)
+        return rc, time.perf_counter() - t0
+
+    t_start = time.time()
+    print(f"trace smoke: n={args.num_nodes} iters={args.iterations} "
+          f"warmup={args.warm_up_rounds} reps={args.reps}")
+
+    # 1. cold runs: compile both arms' graphs once (trace rows compile a
+    # distinct round program), so the timed arms hit a warm jit cache
+    rc, t_cold = timed_run(["--run-report", f"{tmp}/cold.json"])
+    check(rc == 0, f"cold plain run exits 0 (took {t_cold:.2f}s)")
+    rc, t_cold_t = timed_run(["--run-report", f"{tmp}/cold_t.json",
+                              "--trace-dir", f"{tmp}/cold_trace"])
+    check(rc == 0, f"cold traced run exits 0 (took {t_cold_t:.2f}s)")
+
+    # 2. timed plain arm (report only)
+    t_plain = float("inf")
+    plain_report = None
+    for i in range(max(1, args.reps)):
+        path = f"{tmp}/plain_{i}.json"
+        rc, dt = timed_run(["--run-report", path])
+        t_plain = min(t_plain, dt)
+        check(rc == 0, f"plain run {i} exits 0")
+        with open(path) as f:
+            plain_report = json.load(f)
+
+    # 3. timed traced arm
+    t_trace = float("inf")
+    trace_report = None
+    trace_dir = f"{tmp}/trace"
+    for i in range(max(1, args.reps)):
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        path = f"{tmp}/traced_{i}.json"
+        rc, dt = timed_run(["--run-report", path, "--trace-dir", trace_dir])
+        t_trace = min(t_trace, dt)
+        check(rc == 0, f"traced run {i} exits 0")
+        with open(path) as f:
+            trace_report = json.load(f)
+
+    # 4a. schema + load
+    problems = validate_trace_dir(trace_dir)
+    check(problems == [], f"trace manifest + segments validate {problems or ''}")
+    tr = load_trace(trace_dir)
+    measured = args.iterations - args.warm_up_rounds
+    check(len(tr) == measured,
+          f"trace covers all {measured} measured rounds (got {len(tr)})")
+
+    # 4b. per-round invariants: rooted tree, coverage cross-check,
+    # stranded explanations
+    origin = tr.origins[0]
+    trees_ok = cov_ok = expl_ok = True
+    for t in range(len(tr)):
+        dist = tr.arrays["dist"][t, 0]
+        first = tr.arrays["first_src"][t, 0]
+        failed = tr.arrays["failed"][t, 0]
+        _, ok = E.build_delivery_tree(first, dist, origin)
+        trees_ok &= ok
+        covered = int((dist >= 0).sum())
+        cov_ok &= abs(covered / tr.num_nodes
+                      - float(tr.arrays["coverage"][t, 0])) < 1e-6
+        stranded = int(((dist < 0) & ~failed).sum())
+        expl = E.explain_stranded(tr.arrays["active"][t, 0],
+                                  tr.arrays["pruned"][t, 0],
+                                  tr.arrays["peers"][t, 0],
+                                  tr.arrays["code"][t, 0],
+                                  dist, failed, origin)
+        expl_ok &= (len(expl) == stranded
+                    and all(e["summary"] for e in expl))
+    check(trees_ok, "every traced round's delivery tree roots at the origin")
+    check(cov_ok, "per-round covered-node counts match the recorded "
+                  "coverage")
+    check(expl_ok, "every stranded node gets a concrete cause")
+    cov_trace = float(tr.arrays["coverage"].mean())
+    cov_stats = float(trace_report["coverage_mean"])
+    check(abs(cov_trace - cov_stats) < 1e-6,
+          f"trace coverage mean matches the stats layer "
+          f"({cov_trace:.6f} vs {cov_stats:.6f})")
+
+    # 4c. bit-parity: tracing must not change simulation outputs
+    same = all(plain_report[k] == trace_report[k]
+               for k in ("coverage_mean", "rmr_mean"))
+    check(same, "tracing changes no simulation output bits "
+                "(coverage/rmr identical)")
+
+    # 4d. overhead budget
+    budget = t_plain * (1.0 + args.overhead_budget) + args.overhead_slack_s
+    overhead = (t_trace - t_plain) / t_plain if t_plain > 0 else 0.0
+    print(f"  plain={t_plain:.3f}s traced={t_trace:.3f}s "
+          f"overhead={overhead * 100:+.2f}%")
+    check(t_trace <= budget,
+          f"tracing overhead within {args.overhead_budget:.0%} "
+          f"(+{args.overhead_slack_s}s slack)")
+
+    # 5. engine-vs-oracle first-delivery parity (forced active sets)
+    if not args.skip_parity:
+        parity_rounds = 6
+        print(f"  parity: {args.num_nodes} nodes x {parity_rounds} rounds, "
+              f"forced active sets, rotation off, 15% packet loss")
+        import jax
+        import jax.numpy as jnp
+
+        from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                           make_cluster_tables, run_rounds)
+        from gossip_sim_tpu.faults import FaultInjector
+        from gossip_sim_tpu.identity import (NodeIndex, get_stake_bucket,
+                                             pubkey_new_unique)
+        from gossip_sim_tpu.obs.trace import OracleTraceCollector
+        from gossip_sim_tpu.oracle.cluster import Cluster, Node
+
+        n = args.num_nodes
+        rng = np.random.default_rng(args.seed)
+        stakes_arr = rng.choice(np.arange(1, 50 * n), size=n,
+                                replace=False).astype(np.int64) * 10**9
+        accounts = {pubkey_new_unique(): int(s) for s in stakes_arr}
+        index = NodeIndex.from_stakes(accounts)
+        stakes_np = index.stakes.astype(np.int64)
+        tables = make_cluster_tables(stakes_np)
+        params = EngineParams(num_nodes=n, probability_of_rotation=0.0,
+                              warm_up_rounds=0, impair_seed=args.seed,
+                              packet_loss_rate=0.15).validate()
+        origins = jnp.asarray([0], jnp.int32)
+        state = init_state(jax.random.PRNGKey(11), tables, origins, params)
+
+        stakes_map = {pk: int(s) for pk, s in zip(index.pubkeys, stakes_np)}
+        nodes = [Node(pk, stakes_map[pk]) for pk in index.pubkeys]
+        origin_pk = index.pubkeys[0]
+        active = np.asarray(state.active[0])
+        for i, node in enumerate(nodes):
+            bucket = get_stake_bucket(min(stakes_map[node.pubkey],
+                                          stakes_map[origin_pk]))
+            node.active_set.entries[bucket].peers = {
+                index.pubkeys[j]: {index.pubkeys[j]}
+                for j in active[i] if j < n}
+        node_map = {nd.pubkey: nd for nd in nodes}
+        cluster = Cluster(params.push_fanout)
+        impair = FaultInjector(index, seed=args.seed, packet_loss_rate=0.15)
+        collector = OracleTraceCollector(
+            index, origin_pk, push_fanout=params.push_fanout,
+            active_set_size=params.active_set_size,
+            prune_cap=params.prune_cap)
+
+        state, rows = run_rounds(params, tables, origins, state,
+                                 parity_rounds, trace=True)
+        rows = jax.tree_util.tree_map(np.asarray, rows)
+        parity_ok = True
+        for r in range(parity_rounds):
+            impair.begin_round(r)
+            collector.begin_round(cluster, node_map)
+            cluster.run_gossip(origin_pk, stakes_map, node_map, impair)
+            cluster.consume_messages(origin_pk, nodes)
+            cluster.send_prunes(origin_pk, nodes,
+                                params.prune_stake_threshold,
+                                params.min_ingress_nodes, stakes_map)
+            cluster.prune_connections(node_map, stakes_map)
+            collector.end_round(r, cluster, node_map, [])
+        _, block = collector.flush()
+        for r in range(parity_rounds):
+            dist_e = rows["dist"][r, 0]
+            dist_o = block["dist"][r, 0]
+            parity_ok &= np.array_equal(dist_e, dist_o)
+            parity_ok &= np.array_equal(rows["trace_first"][r, 0],
+                                        block["first_src"][r, 0])
+            edges_e = E.delivered_edges(rows["trace_peers"][r, 0],
+                                        rows["trace_code"][r, 0], dist_e)
+            edges_o = E.delivered_edges(block["peers"][r, 0],
+                                        block["code"][r, 0], dist_o)
+            parity_ok &= (set(E.edge_keys(edges_e, n).tolist())
+                          == set(E.edge_keys(edges_o, n).tolist()))
+        check(parity_ok, "engine and oracle traces record identical "
+                         "distances, first-delivery and delivered edge "
+                         "sets under a fixed seed")
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(f"  elapsed: {time.time() - t_start:.1f}s")
+    if failures:
+        print(f"TRACE SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("TRACE SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
