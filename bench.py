@@ -79,9 +79,22 @@ def worker(args) -> int:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
-    from gossip_sim_tpu.engine import (EngineParams, init_state,
-                                       make_cluster_tables, run_rounds)
+    from gossip_sim_tpu.engine import (EngineParams, enable_persistent_cache,
+                                       init_state, make_cluster_tables,
+                                       persistent_cache_counters,
+                                       persistent_cache_dir, run_rounds)
     from gossip_sim_tpu.obs import bench_summary, get_registry
+
+    # persistent XLA compilation cache (engine/cache.py): repeat BENCH runs
+    # with GOSSIP_COMPILATION_CACHE set reuse the compiled round across
+    # processes, and the hit/miss counts ride along in the JSON line.  A
+    # broken cache dir must not kill the rung (the armored-bench contract:
+    # a number is always printed) — run uncached instead.
+    try:
+        enable_persistent_cache()
+    except Exception as e:
+        print(f"persistent compilation cache unavailable ({e}); "
+              f"running uncached", file=sys.stderr)
 
     platform = jax.devices()[0].platform
     n, o = args.num_nodes, args.origin_batch
@@ -108,12 +121,56 @@ def worker(args) -> int:
                                  args.iterations, start_it=args.warmup_timing)
         jax.block_until_ready(rows)
     reg.add("origin_iters", o * args.iterations)
+    coverage_mean = float(np.asarray(rows["coverage"]).mean())
+    rmr_mean = float(np.asarray(rows["rmr"]).mean())
+
+    # ---- sweep rung: warm-executable sweep throughput ------------------
+    # Steps a numeric EngineKnobs field per simulated point (the sweep
+    # harness pattern, gossip_main.rs:774-951).  Step 0 compiles the
+    # sweep-block shape once; the timed steps 1..K then measure pure
+    # compile-free sweep throughput — the amortization the dynamic-knob
+    # split buys (sweep cost = compile + K*run, not K*(compile+run)).
+    from gossip_sim_tpu.engine import compiled_cache_size
+    sweep_steps = args.sweep_steps
+    sweep_iters = max(1, min(10, args.iterations))
+    it_at = args.warmup_timing + args.iterations
+
+    def sweep_params(k):
+        return params._replace(
+            probability_of_rotation=0.013333 + 1e-4 * (k + 1))
+
+    state, srows = run_rounds(sweep_params(0), tables, origins, state,
+                              sweep_iters, start_it=it_at)
+    jax.block_until_ready(srows["coverage"])
+    it_at += sweep_iters
+    c_before = compiled_cache_size()
+    t_sweep = time.perf_counter()
+    for k in range(1, sweep_steps + 1):
+        state, srows = run_rounds(sweep_params(k), tables, origins, state,
+                                  sweep_iters, start_it=it_at)
+        jax.block_until_ready(srows["coverage"])
+        it_at += sweep_iters
+    sweep_dt = time.perf_counter() - t_sweep
+    sweep_compiles = (compiled_cache_size() - c_before
+                      if c_before >= 0 else -1)
 
     result = bench_summary(
         reg, platform=platform, num_nodes=n, origin_batch=o,
         iterations=args.iterations,
-        coverage_mean=float(np.asarray(rows["coverage"]).mean()),
-        rmr_mean=float(np.asarray(rows["rmr"]).mean()))
+        coverage_mean=coverage_mean, rmr_mean=rmr_mean)
+    result["sweep_steps_per_sec"] = round(
+        sweep_steps / sweep_dt, 2) if sweep_dt > 0 else 0.0
+    result["sweep"] = {
+        "steps": sweep_steps,
+        "iters_per_step": sweep_iters,
+        "warm_steps_elapsed_s": round(sweep_dt, 3),
+        "compiles_during_warm_steps": sweep_compiles,
+    }
+    pc = persistent_cache_counters()
+    result["compilation_cache"] = {
+        "dir": persistent_cache_dir() or "",
+        "hits": pc["hits"], "misses": pc["misses"],
+    }
     print(json.dumps(result))
     return 0
 
@@ -190,6 +247,9 @@ def main():
     ap.add_argument("--origin-batch", type=int, default=32)
     ap.add_argument("--iterations", type=int, default=100)
     ap.add_argument("--warmup-timing", type=int, default=5)
+    ap.add_argument("--sweep-steps", type=int, default=3,
+                    help="warm-executable sweep steps timed for the "
+                         "sweep_steps_per_sec rung")
     ap.add_argument("--worker", action="store_true",
                     help="internal: run the measurement in-process")
     ap.add_argument("--timeout", type=int, default=0,

@@ -10,6 +10,7 @@ the origin-parallel vmap mode the reference lacks.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import logging
 import os
 import sys
@@ -83,6 +84,37 @@ def _engine_call_span(reg, fallback: str = "engine/rounds"):
     name = ("engine/compile" if reg.count("engine/compile") == 0
             else fallback)
     return reg.span(name), name == "engine/rounds"
+
+
+def _enable_compilation_cache(config) -> None:
+    """Persistent XLA compilation cache (engine/cache.py): point JAX at
+    ``--compilation-cache-dir`` / $GOSSIP_COMPILATION_CACHE so compiled
+    executables survive this process.  Called from every TPU run path;
+    idempotent, no-op when neither source names a directory.  A broken
+    cache directory is a lost optimization, not a dead run: failures warn
+    and the simulation proceeds uncached."""
+    from .engine import enable_persistent_cache
+    try:
+        ccdir = enable_persistent_cache(config.compilation_cache_dir)
+    except Exception as e:
+        log.warning("WARNING: could not enable the persistent compilation "
+                    "cache (%s); continuing uncached", e)
+        ccdir = None
+    get_registry().set_info("compilation_cache_dir", ccdir or "")
+
+
+def _sync_cache_counters() -> None:
+    """Push the persistent-cache hit/miss counts and the engine's
+    compile/reuse counters' backing info into the registry so run reports
+    and bench lines carry them.  Safe when JAX never came up (oracle-only
+    runs): the engine package is only consulted if already imported."""
+    if "gossip_sim_tpu.engine.cache" not in sys.modules:
+        return
+    from .engine.cache import persistent_cache_counters, persistent_cache_dir
+    reg = get_registry()
+    reg.set_info("persistent_cache", persistent_cache_counters())
+    if reg.info("compilation_cache_dir") is None:
+        reg.set_info("compilation_cache_dir", persistent_cache_dir() or "")
 
 
 def _impair_params(config) -> dict:
@@ -248,6 +280,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(origin, round); 0 = auto (16 * num_nodes). "
                         "Raise when the trace manifest flags "
                         "truncated_prune_rounds")
+    p.add_argument("--compilation-cache-dir", default="", metavar="DIR",
+                   help="tpu backend: persistent XLA compilation cache "
+                        "(engine/cache.py). Compiled executables are "
+                        "serialized to DIR and reused by later processes "
+                        "(sweep re-runs, CI, bench). Defaults to "
+                        "$GOSSIP_COMPILATION_CACHE when unset")
     p.add_argument("--checkpoint-path", default="",
                    help="save the simulation state (SimState arrays + "
                         "params) to this .npz after each measured block and "
@@ -312,6 +350,7 @@ def config_from_args(args) -> Config:
         trace_dir=args.trace_dir,
         trace_origins=args.trace_origins,
         trace_prune_cap=args.trace_prune_cap,
+        compilation_cache_dir=args.compilation_cache_dir,
     )
 
 
@@ -527,6 +566,7 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
                          run_rounds)
 
     reg = get_registry()
+    _enable_compilation_cache(config)
     index = NodeIndex.from_stakes(accounts)
     stakes = dict(accounts)
     N = len(index)
@@ -643,7 +683,6 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
         return stakes
 
     # Harvest measured rounds in blocks to bound host-side detail arrays.
-    import contextlib
     profile_cm = (jax.profiler.trace(config.jax_profile_dir)
                   if config.jax_profile_dir else contextlib.nullcontext())
     block = 256
@@ -805,6 +844,7 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         **_impair_params(config),
     )
     reg = get_registry()
+    _enable_compilation_cache(config)
     with reg.span("engine/tables"):
         tables = make_cluster_tables(index.stakes.astype(np.int64))
     reg.set_info("platform", jax.devices()[0].platform)
@@ -988,6 +1028,7 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
     if accounts is None:
         accounts, _ = load_cluster_accounts(config, json_rpc_url)
     reg = get_registry()
+    _enable_compilation_cache(config)
     index = NodeIndex.from_stakes(accounts)
     N = len(index)
     reg.set_info("num_nodes", N)
@@ -1024,48 +1065,98 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
                    else np.asarray(origin_indices, dtype=np.int32))
     total_o = len(all_origins)
     batch = config.origin_batch or max(1, min(64, (1 << 22) // max(N, 1)))
+    if total_o > 0:
+        batch = min(batch, total_o)
     if mesh is not None:
         batch = max(mesh_dev, batch // mesh_dev * mesh_dev)
     reg.set_info("origin_batch", batch)
     reg.set_info("mesh_shape", [mesh_dev] if mesh is not None else [1])
+    single_batch = total_o <= batch
 
     agg = AllOriginsStats(index, params.hist_bins)
     hb = Heartbeat(total_o, label="all-origins", unit="origin")
+    # the registry counter is process-cumulative; the summary reports this
+    # run's delta so library callers invoking run_all_origins repeatedly
+    # (tests, the driver dryrun) don't inherit earlier runs' padding
+    padded_before = reg.counter("padded_sims")
     t0 = time.time()
-    for lo in range(0, total_o, batch):
+
+    def _dispatch(lo):
+        """Launch one origin batch (init + rounds) without waiting on the
+        device.  Every chunk — including the tail — is padded to the full
+        ``batch`` width so the whole run compiles exactly one batch shape;
+        padded sims run on origin 0 and are sliced off before aggregation
+        (``padded_sims`` counts them in the run report)."""
         chunk = all_origins[lo:lo + batch]
         n_valid = len(chunk)
-        if mesh is not None and n_valid % mesh_dev != 0:
-            # pad the final batch to the mesh width; padded sims run but
-            # their columns/rows are sliced off before aggregation
-            pad = mesh_dev - n_valid % mesh_dev
-            chunk = np.concatenate([chunk, np.zeros(pad, np.int32)])
+        if n_valid < batch:
+            reg.add("padded_sims", batch - n_valid)
+            chunk = np.concatenate(
+                [chunk, np.zeros(batch - n_valid, np.int32)])
         origins = jnp.asarray(chunk, dtype=jnp.int32)
         with reg.span("engine/init"):
             state = init_state(jax.random.PRNGKey(config.seed), tables,
                                origins, params)
-            jax.block_until_ready(state)
         if mesh is not None:
             from .parallel import shard_sim
             state, origins = shard_sim(mesh, state, origins,
                                        shard_nodes=False)
-        # the first batch's scan call carries the compile (per obs/report.py
-        # span conventions); later batches of the same width hit the cache.
-        # A single-batch run has no steady-state batch to time, so it
-        # records under engine/rounds with the compile embedded (the same
-        # caveat a freshly-compiled bench elapsed_s has) rather than
-        # reporting zero throughput.
-        single_batch = total_o <= batch
-        span_name = ("engine/rounds" if lo > 0 or single_batch
-                     else "engine/compile")
+        # Span conventions (obs/report.py): the first batch's call carries
+        # the compile (host-blocking at dispatch) and records under
+        # engine/compile; later batches dispatch asynchronously and their
+        # device time records under engine/rounds at harvest.  A
+        # single-batch run has no steady-state batch to time, so it records
+        # under engine/rounds with the compile embedded (the same caveat a
+        # freshly-compiled bench elapsed_s has) rather than reporting zero
+        # throughput.
         t_blk = time.perf_counter()
-        with reg.span(span_name):
-            state, rows = run_rounds(params, tables, origins, state,
-                                     config.gossip_iterations)
+        if single_batch:
+            with reg.span("engine/rounds"):
+                state, rows = run_rounds(params, tables, origins, state,
+                                         config.gossip_iterations)
+                rows = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a)[..., :n_valid], rows)
+            harvested = True
+        else:
+            cm = (reg.span("engine/compile") if lo == 0
+                  else contextlib.nullcontext())
+            with cm:
+                state, rows = run_rounds(params, tables, origins, state,
+                                         config.gossip_iterations)
+            harvested = False
+        counted = lo > 0 or single_batch
+        return (lo, n_valid, state, rows, t_blk, time.perf_counter(),
+                counted, harvested)
+
+    # end of the last engine/rounds window: batch timing windows are
+    # clamped to start no earlier than the previous one ended, so the
+    # pipelined windows tile the steady state instead of overlapping
+    # (their sum stays <= wall-clock)
+    rounds_end = [0.0]
+
+    def _harvest(job):
+        """Block on one dispatched batch and feed the aggregates.  With
+        double buffering the next batch is already queued on the device, so
+        this host-side work (np.asarray transfer + stats accumulation)
+        overlaps its compute instead of serializing on it."""
+        lo, n_valid, state, rows, t_blk, t_disp_end, counted, harvested = job
+        if harvested:
+            blk_wall = time.perf_counter() - t_blk
+        else:
+            # engine/rounds keeps its pre-pipelining meaning — device
+            # compute from dispatch-complete to results-on-host — so the
+            # throughput denominators (obs/report.py) stay comparable; the
+            # clamp keeps consecutive windows from double-counting the
+            # overlapped host work between them
+            basis = max(t_disp_end, rounds_end[0])
             rows = jax.tree_util.tree_map(
                 lambda a: np.asarray(a)[..., :n_valid], rows)
-        blk_wall = time.perf_counter() - t_blk
-        if span_name == "engine/rounds":
+            end = time.perf_counter()
+            blk_wall = end - basis
+            rounds_end[0] = end
+            if counted:
+                reg.record("engine/rounds", blk_wall)
+        if counted:
             reg.add("origin_iters", n_valid * config.gossip_iterations)
             reg.add("messages_delivered", int(rows["delivered"].sum()))
         with reg.span("stats/harvest"):
@@ -1080,6 +1171,19 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         log.info("all-origins: %s/%s origins done",
                  min(lo + n_valid, total_o), total_o)
         hb.beat(min(lo + n_valid, total_o))
+
+    # double-buffered pipeline: dispatch batch k+1 before harvesting batch
+    # k, so the host-side harvest overlaps the device compute of the next
+    # batch (two batches are in flight at peak — budget device memory for
+    # 2x the batch state when sizing --origin-batch)
+    pending = None
+    for lo in range(0, total_o, batch):
+        job = _dispatch(lo)
+        if pending is not None:
+            _harvest(pending)
+        pending = job
+    if pending is not None:
+        _harvest(pending)
     dt = time.time() - t0
 
     if config.trace_dir:
@@ -1101,6 +1205,7 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
             "coverage_mean": 0.0, "rmr_mean": 0.0, "elapsed_s": dt,
             "origin_iters_per_sec": total_o * config.gossip_iterations / dt,
             "mesh_devices": mesh_dev if mesh is not None else 1,
+            "padded_sims": int(reg.counter("padded_sims") - padded_before),
             "stats": agg,
         }
     agg.finalize(config)
@@ -1121,6 +1226,7 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         "elapsed_s": dt,
         "origin_iters_per_sec": total_o * config.gossip_iterations / dt,
         "mesh_devices": mesh_dev if mesh is not None else 1,
+        "padded_sims": int(reg.counter("padded_sims") - padded_before),
         "stats": agg,
     }
     log.info("ALL-ORIGINS SUMMARY: %s",
@@ -1374,6 +1480,7 @@ def _write_run_report(config, stats=None, faults=None, influx=None):
         return
     from .obs.report import (build_run_report, validate_run_report,
                              write_run_report)
+    _sync_cache_counters()
     report = build_run_report(config, get_registry(), stats=stats,
                               influx=influx, faults=faults)
     problems = validate_run_report(report)

@@ -130,6 +130,11 @@ class Config:
                                     # round); 0 = auto (16*num_nodes).
                                     # Raise when the trace manifest flags
                                     # truncated_prune_rounds
+    compilation_cache_dir: str = ""  # persistent XLA compilation cache
+                                    # (engine/cache.py): compiled
+                                    # executables are reused across
+                                    # processes/CI runs; "" falls back to
+                                    # $GOSSIP_COMPILATION_CACHE, unset = off
 
     def stepped(self, **kw) -> "Config":
         return replace(self, **kw)

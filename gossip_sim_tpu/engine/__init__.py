@@ -17,11 +17,18 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from .params import EngineParams  # noqa: E402
+from .params import EngineKnobs, EngineParams, EngineStatic  # noqa: E402
 from .sampler import SamplerTables, build_sampler_tables  # noqa: E402
+from .cache import (  # noqa: E402
+    enable_persistent_cache,
+    persistent_cache_counters,
+    persistent_cache_dir,
+)
 from .core import (  # noqa: E402
     ClusterTables,
     SimState,
+    clear_compile_cache,
+    compiled_cache_size,
     init_state,
     make_cluster_tables,
     round_step,
@@ -29,11 +36,18 @@ from .core import (  # noqa: E402
 )
 
 __all__ = [
+    "EngineKnobs",
     "EngineParams",
+    "EngineStatic",
     "SamplerTables",
     "build_sampler_tables",
     "ClusterTables",
     "SimState",
+    "clear_compile_cache",
+    "compiled_cache_size",
+    "enable_persistent_cache",
+    "persistent_cache_counters",
+    "persistent_cache_dir",
     "init_state",
     "make_cluster_tables",
     "round_step",

@@ -1,0 +1,103 @@
+"""Persistent XLA compilation cache wiring + hit/miss accounting.
+
+The dynamic-knob split (engine/params.py) makes sweeps compile-once
+*within* a process; this module extends the amortization *across*
+processes: point JAX's persistent compilation cache at a directory
+(``--compilation-cache-dir`` or the ``GOSSIP_COMPILATION_CACHE`` env var)
+and every compiled executable — the round scan, init, the oracle-parity
+harnesses — is serialized there, so repeat CLI runs, CI jobs and bench
+rungs skip straight to execution.
+
+JAX's defaults only persist programs that took >= 1s to compile and are
+>= some size; :func:`enable_persistent_cache` zeroes both thresholds so
+CI-scale programs persist too.  Hit/miss counts are collected from
+``jax.monitoring`` events and surfaced in run reports and BENCH lines
+(``compilation_cache`` section).
+
+This module imports JAX lazily: importing it costs nothing, only enabling
+the cache touches the backend config.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "GOSSIP_COMPILATION_CACHE"
+
+_counts = {"hits": 0, "misses": 0}
+_listener_registered = False
+_enabled_dir: str | None = None
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        _counts["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        _counts["misses"] += 1
+
+
+def enable_persistent_cache(path: str = "") -> str | None:
+    """Enable JAX's persistent compilation cache at ``path``.
+
+    ``path`` falls back to the ``GOSSIP_COMPILATION_CACHE`` env var; with
+    neither set this is a no-op returning None.  Returns the directory in
+    effect.  Idempotent — the CLI's sweep loops call it once per simulated
+    point."""
+    global _listener_registered, _enabled_dir
+    path = path or os.environ.get(ENV_VAR, "")
+    if not path:
+        return _enabled_dir
+    if path == _enabled_dir:
+        # already in effect: repeat calls (one per sweep point) must not
+        # rewrite jax config or reset the live cache handle
+        return _enabled_dir
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    for flag, value in (
+            # persist every program, however small/fast — a CI sweep's
+            # first process should hand its successor a warm cache
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ("jax_persistent_cache_min_compile_time_secs", 0)):
+        try:
+            jax.config.update(flag, value)
+        except Exception:  # pragma: no cover - flag renamed in other jax
+            log.debug("persistent-cache flag %s unavailable", flag)
+    # JAX initializes its cache handle exactly once, on the first compile.
+    # Importing the engine already compiled tiny module constants, so that
+    # one-shot init ran with no directory configured and pinned the cache
+    # off; reset it so the directory set above takes effect.
+    try:
+        from jax.experimental.compilation_cache import (compilation_cache as
+                                                        _cc)
+        _cc.reset_cache()
+    except Exception:  # pragma: no cover - internal API drift
+        log.warning("could not re-initialize the JAX compilation cache; "
+                    "persistent caching may be inactive this process")
+    if not _listener_registered:
+        try:
+            from jax import monitoring
+            monitoring.register_event_listener(_on_event)
+            _listener_registered = True
+        except Exception:  # pragma: no cover - monitoring API drift
+            log.debug("jax.monitoring listener unavailable; persistent-"
+                      "cache hit/miss counts will read 0")
+    if _enabled_dir != path:
+        log.info("persistent compilation cache enabled at %s", path)
+    _enabled_dir = path
+    return path
+
+
+def persistent_cache_counters() -> dict:
+    """{"hits": ..., "misses": ...} observed since the cache was enabled
+    (all zero when it never was)."""
+    return dict(_counts)
+
+
+def persistent_cache_dir() -> str | None:
+    """The directory in effect, or None when the cache is disabled."""
+    return _enabled_dir

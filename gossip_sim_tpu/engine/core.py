@@ -71,11 +71,12 @@ import numpy as np
 from jax import lax
 
 from ..faults import (SALT_CHURN, SALT_EDGE, edge_u32_arr, node_u32_arr,
-                      rate_threshold, round_basis_arr, stake_bipartition)
+                      rate_threshold_arr, round_basis_arr, stake_bipartition)
 from ..identity import stake_buckets_array
+from ..obs.spans import get_registry
 from ..obs.trace import (TRACE_CANDIDATE, TRACE_DROPPED, TRACE_FAILED_TARGET,
                          TRACE_SUPPRESSED)
-from .params import EngineParams
+from .params import EngineKnobs, EngineParams, EngineStatic
 from .sampler import SamplerTables, build_sampler_tables
 
 INF = jnp.int32(1 << 20)   # unreached sentinel (maps to u64::MAX, gossip.rs:490)
@@ -328,10 +329,66 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
 # the round
 # --------------------------------------------------------------------------
 
-def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
+def _check_knob_gates(static: EngineStatic, kn: EngineKnobs) -> None:
+    """Explicit-knobs consistency guard: the impairment blocks exist in the
+    compiled graph only where the static gates say so, so an *active* knob
+    value against a False gate would be silently ignored (e.g. a nonzero
+    packet_loss_rate with has_loss=False runs loss-free) — wrong physics,
+    raised as an error.  The reverse direction is allowed: an off/zero knob
+    against a True gate is bit-correct (the gated blocks reduce exactly to
+    the unimpaired graph at their off endpoints), which is what lets a
+    knobs= sweep include 0 without a recompile.  Skipped when the knob
+    leaves are traced (the internal jit path — checked at the boundary)."""
+    try:
+        implied = {
+            "has_loss": float(kn.packet_loss_rate) > 0.0,
+            "has_churn": (float(kn.churn_fail_rate) > 0.0
+                          or float(kn.churn_recover_rate) > 0.0),
+            "has_partition": int(kn.partition_at) >= 0,
+            "has_fail": (int(kn.fail_at) >= 0
+                         and float(kn.fail_fraction) > 0.0),
+        }
+    except Exception:   # traced leaves have no concrete value here
+        return
+    missing = [g for g, want in implied.items()
+               if want and not getattr(static, g)]
+    if missing:
+        raise ValueError(
+            f"knob values require the {missing} impairment block(s) but the "
+            f"EngineStatic compile key gates them out — the compiled graph "
+            f"would silently ignore them. Build the EngineParams with the "
+            f"target values (or a matching static) instead.")
+
+
+def _split_params(params, knobs):
+    """Resolve (params, knobs) call forms into (EngineStatic, EngineKnobs)
+    — the single split point round_step and run_rounds share, including
+    the explicit-knobs gate guard."""
+    if isinstance(params, EngineParams):
+        static, kn = params.split()
+        if knobs is not None:
+            kn = knobs
+            _check_knob_gates(static, kn)
+        return static, kn
+    if knobs is None:
+        raise TypeError("an EngineStatic compile key requires "
+                        "knobs=EngineKnobs(...)")
+    _check_knob_gates(params, knobs)
+    return params, knobs
+
+def round_step(params, tables: ClusterTables, origins: jax.Array,
                state: SimState, it: jax.Array, detail: bool = False,
-               edge_detail: bool = False, trace: bool = False):
+               edge_detail: bool = False, trace: bool = False,
+               knobs: EngineKnobs | None = None):
     """One full gossip round for all O origin-sims.  Returns (state, rows).
+
+    ``params`` is either a full (concrete) :class:`EngineParams` — whose
+    numeric knobs are then baked into the containing trace as constants,
+    the historical behavior — or an :class:`EngineStatic` compile key, in
+    which case ``knobs`` must carry the :class:`EngineKnobs` pytree of
+    (possibly traced) scalars.  ``_run`` uses the second form so a sweep
+    stepping any knob reuses one compiled executable; the two forms emit
+    bit-identical results for equal values.
 
     ``trace`` additionally emits the flight-recorder event rows consumed by
     :mod:`gossip_sim_tpu.obs.trace` (candidate push slots with per-edge
@@ -341,7 +398,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
     state transition and every non-trace row are bit-identical with the
     flag on or off, and with it off (the default) the compiled graph is
     unchanged."""
-    p = params
+    p, kn = _split_params(params, knobs)
     N, S, F, C, K, H = (p.num_nodes, p.active_set_size, p.push_fanout,
                         p.rc_slots, p.k_inbound, p.hist_bins)
     F = min(F, S)
@@ -362,33 +419,36 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         # ---- fault injection (gossip.rs:756-771; fires when it == when_to_fail,
         # gossip_main.rs:449-452) --------------------------------------------
         failed, tfail = state.failed, state.tfail
-        # truncating, like the reference's `as usize` (gossip.rs:758)
-        n_fail = int(p.fail_fraction * N)
-        if p.fail_at >= 0 and n_fail > 0:
+        if p.has_fail:
+            # truncating, like the reference's `as usize` (gossip.rs:758);
+            # the f64 product matches the host double arithmetic bit-for-bit
+            n_fail = jnp.floor(kn.fail_fraction * N).astype(jnp.int32)
+
             def _fail(ft):
                 f, _ = ft
                 r = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
                     subs[:, 0])
-                kth = jnp.sort(r, axis=-1)[:, n_fail - 1][:, None]
+                kidx = jnp.clip(n_fail - 1, 0, N - 1)
+                kth = jnp.sort(r, axis=-1)[:, kidx][:, None]
                 f = f | (r <= kth)
                 # rebuild per-slot target-failed bits via sort-join (once)
                 q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
                 tf = _lookup(f.astype(jnp.int32), q, N,
                              pack).reshape(O, N, S) == 1
                 return f, tf & (state.active < N)
-            failed, tfail = lax.cond(it == p.fail_at, _fail,
-                                     lambda ft: ft, (failed, tfail))
+            failed, tfail = lax.cond((it == kn.fail_at) & (n_fail > 0),
+                                     _fail, lambda ft: ft, (failed, tfail))
 
     with jax.named_scope("round/churn"):
         # ---- continuous churn (faults.py): one hash per (iteration, node),
         # interpreted against the node's current state; recovered nodes rejoin
         # delivery immediately (their tfail bits clear this round) -------------
         if p.has_churn:
-            basis_c = round_basis_arr(p.impair_seed, it, SALT_CHURN, jnp)
+            basis_c = round_basis_arr(kn.impair_seed, it, SALT_CHURN, jnp)
             hu64 = node_u32_arr(basis_c, jnp.arange(N, dtype=jnp.uint32),
                                 jnp).astype(jnp.uint64)
-            fail_ev = hu64 < rate_threshold(p.churn_fail_rate)       # [N]
-            rec_ev = hu64 < rate_threshold(p.churn_recover_rate)     # [N]
+            fail_ev = hu64 < rate_threshold_arr(kn.churn_fail_rate, jnp)  # [N]
+            rec_ev = hu64 < rate_threshold_arr(kn.churn_recover_rate, jnp)
             failed = jnp.where(failed, ~rec_ev[None, :], fail_ev[None, :])
             q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
             tfail = (_lookup(failed.astype(jnp.int32), q, N,
@@ -413,20 +473,24 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         # partition > loss — matching the oracle's classify_edge)
         deliver_ok = slot_ok & (tfail_sf[..., :F] == 0)              # [O,N,F]
         sup_mask = drop_mask = None
-        if p.partition_at >= 0:
-            part_on = it >= p.partition_at
-            if p.heal_at >= 0:
-                part_on = part_on & (it < p.heal_at)
+        if p.has_partition:
+            # window [partition_at, heal_at); heal_at < 0 = never heals,
+            # partition_at < 0 = never starts (matches the oracle's
+            # partition_active) — both bounds are traced knobs, so the
+            # window itself is sweepable, including its off endpoint
+            part_on = ((kn.partition_at >= 0) & (it >= kn.partition_at)
+                       & ((kn.heal_at < 0) | (it < kn.heal_at)))
             side_dst = tables.side[jnp.minimum(peerF, N)]            # [O,N,F]
             sup_mask = (deliver_ok & part_on
                         & (tables.side[:N][None, :, None] != side_dst))
             deliver_ok = deliver_ok & ~sup_mask
-        if p.packet_loss_rate > 0.0:
-            basis_e = round_basis_arr(p.impair_seed, it, SALT_EDGE, jnp)
+        if p.has_loss:
+            basis_e = round_basis_arr(kn.impair_seed, it, SALT_EDGE, jnp)
             ue = edge_u32_arr(basis_e, iota_n.astype(jnp.uint32)[:, :, None],
                               peerF.astype(jnp.uint32), jnp)
-            drop_mask = deliver_ok & (ue.astype(jnp.uint64)
-                                      < rate_threshold(p.packet_loss_rate))
+            drop_mask = deliver_ok & (
+                ue.astype(jnp.uint64)
+                < rate_threshold_arr(kn.packet_loss_rate, jnp))
             deliver_ok = deliver_ok & ~drop_mask
         tgt = jnp.where(deliver_ok, peerF, N)                        # [O,N,F]
         tgtf = tgt.reshape(O, NF)
@@ -628,7 +692,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         # f64 multiply then u64 truncation, as the reference does
         # (received_cache.rs:112-115).
         min_ingress_stake = (min_stake.astype(jnp.float64)
-                             * p.prune_stake_threshold).astype(jnp.int64)
+                             * kn.prune_stake_threshold).astype(jnp.int64)
 
         member = rc_src < N
         mx = jnp.iinfo(jnp.int32).max
@@ -645,7 +709,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         cum_excl = jnp.cumsum(stake_sorted, axis=-1) - stake_sorted
         posn = jnp.arange(C)[None, None, :]
         pruned_slot = (memb_sorted
-                       & (posn >= p.min_ingress_nodes)
+                       & (posn >= kn.min_ingress_nodes)
                        & (cum_excl >= min_ingress_stake[..., None])
                        & (src_sorted != origin_col)
                        & fired[..., None])
@@ -736,7 +800,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         # ---- verb 5: rotate (gossip.rs:739-754; push_active_set.rs:153-186) -
         rot_u = jax.vmap(lambda k: jax.random.uniform(k, (N,), dtype=jnp.float32))(
             subs[:, 1])
-        rotate = rot_u < p.probability_of_rotation
+        rotate = rot_u < kn.probability_of_rotation
         T = p.rot_tries
         u_all = jax.vmap(
             lambda ks: jax.vmap(
@@ -811,7 +875,7 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
         rmr = jnp.where(nn > 1, m_total / jnp.maximum(nn - 1, 1) - 1.0, 0.0)
         branching = m_push / jnp.maximum(nn, 1)   # Σ|pushes[src]| / |pushes|
 
-        measured = it >= p.warm_up_rounds
+        measured = it >= kn.warm_up_rounds
         g = measured.astype(jnp.int32)
         new_state = SimState(
             key=state.key,
@@ -891,25 +955,70 @@ def round_step(params: EngineParams, tables: ClusterTables, origins: jax.Array,
 # multi-round runner
 # --------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnums=(0, 4, 5, 6, 7), donate_argnums=(3,))
-def _run(params, tables, origins, state, num_iters, detail, edge_detail,
-         trace, start_it):
+@partial(jax.jit, static_argnums=(0, 5, 6, 7, 8), donate_argnums=(3,))
+def _run(static, tables, origins, state, knobs, num_iters, detail,
+         edge_detail, trace, start_it):
     def step(st, it):
-        return round_step(params, tables, origins, st, it, detail=detail,
-                          edge_detail=edge_detail, trace=trace)
+        return round_step(static, tables, origins, st, it, detail=detail,
+                          edge_detail=edge_detail, trace=trace, knobs=knobs)
     its = jnp.arange(num_iters) + start_it
     return lax.scan(step, state, its)
 
 
-def run_rounds(params: EngineParams, tables: ClusterTables, origins: jax.Array,
+def compiled_cache_size() -> int:
+    """Number of executables in ``_run``'s jit cache (-1 if the running
+    JAX version exposes no cache introspection).  The recompile-count
+    regression guard (tests, tools/sweep_smoke.py) asserts on deltas of
+    this value across sweep steps."""
+    try:
+        return int(_run._cache_size())
+    except Exception:  # pragma: no cover - older/newer jax internals
+        return -1
+
+
+def clear_compile_cache() -> None:
+    """Drop every compiled ``_run`` executable (forces a fresh compile on
+    the next call) — the reference arm of compile-once equivalence checks."""
+    try:
+        _run.clear_cache()
+    except Exception:  # pragma: no cover
+        pass
+
+
+def _note_compile_accounting(before: int, after: int) -> None:
+    """Record executable compiles vs reuses on the shared span registry
+    (``engine/compiles`` / ``engine/cache_hits``; obs/report.py)."""
+    if before < 0 or after < 0:
+        return
+    reg = get_registry()
+    if after > before:
+        reg.add("engine/compiles", after - before)
+    else:
+        reg.add("engine/cache_hits", 1)
+
+
+def run_rounds(params, tables: ClusterTables, origins: jax.Array,
                state: SimState, num_iters: int, start_it=0,
                detail: bool = False, edge_detail: bool = False,
-               trace: bool = False):
+               trace: bool = False, knobs: EngineKnobs | None = None):
     """Run ``num_iters`` rounds under one jitted scan (the reference's hot
     loop, gossip_main.rs:425-565).  Returns (state, rows-of-arrays with a
     leading [num_iters] axis).  ``edge_detail`` additionally exports the
     per-edge (src, fanout-slot) -> (target, hop) matrices per round;
-    ``trace`` the flight-recorder event rows (obs/trace.py)."""
-    return _run(params, tables, origins, state, int(num_iters), bool(detail),
-                bool(edge_detail), bool(trace),
-                jnp.asarray(start_it, jnp.int32))
+    ``trace`` the flight-recorder event rows (obs/trace.py).
+
+    The jit boundary splits ``params`` (engine/params.py): only the
+    ``EngineStatic`` compile key is hashed, while the numeric knobs flow in
+    as traced device scalars — so a K-sim sweep stepping any
+    ``EngineKnobs`` field (rotation probability, prune threshold, the
+    impairment rates/windows, warm-up boundary, ...) compiles once and
+    reuses the executable K times.  Every call records either
+    ``engine/compiles`` or ``engine/cache_hits`` on the default span
+    registry (the recompile-count regression guard)."""
+    static, kn = _split_params(params, knobs)
+    before = compiled_cache_size()
+    out = _run(static, tables, origins, state, kn, int(num_iters),
+               bool(detail), bool(edge_detail), bool(trace),
+               jnp.asarray(start_it, jnp.int32))
+    _note_compile_accounting(before, compiled_cache_size())
+    return out

@@ -1,20 +1,129 @@
-"""Static engine parameters (hashable; baked into each compiled round step).
+"""Engine parameters: static compile geometry vs dynamic (traced) knobs.
 
 Mirrors the reference's flat ``Config`` (gossip.rs:111-133) plus the dense
-shapes the TPU formulation introduces.  Sweeps (gossip_main.rs:774-951) step
-one field per simulation; each distinct value compiles once and is cached.
+shapes the TPU formulation introduces.  ``EngineParams`` stays the single
+user-facing NamedTuple (the CLI, checkpoints and tests construct it as
+before), but the jit boundary splits it in two:
+
+* ``EngineStatic`` — shape/structure fields (array extents, ranking widths,
+  iteration-loop structure) plus the *coarse* graph-selection booleans
+  (``has_loss``/``has_churn``/``has_partition``/``has_fail``).  This tuple
+  is the only hashable compile key: a new value compiles a new executable.
+* ``EngineKnobs`` — every numeric tuning knob, carried as a pytree of
+  fixed-dtype numpy scalars that flow into ``round_step``/``_run`` as
+  *traced* device scalars.  Stepping any knob across a K-sim sweep
+  (gossip_main.rs:774-951) therefore reuses one compiled executable K
+  times: sweep cost is ``compile + K*run`` instead of ``K*(compile+run)``.
+
+The knob dtypes are part of the bit-exactness contract with both the CPU
+oracle and the pre-split engine (which baked the knobs in as weakly-typed
+Python constants):
+
+* ``probability_of_rotation`` is f32 — it is compared against f32 uniforms,
+  and a weak f64 literal in that comparison was cast to f32 anyway;
+* the stake-threshold / impairment rates are f64 — the oracle evaluates
+  them in host double precision (``int(rate * 2**32)``,
+  received_cache.rs:112-115) and the engine must match bit-for-bit;
+* iteration boundaries are i32 (the traced iteration counter's dtype) and
+  ``impair_seed`` is u32 (the counter-hash lane width, faults.py).
 """
 
 from __future__ import annotations
 
 from typing import NamedTuple
 
+import numpy as np
+
 from ..constants import (MIN_NUM_UPSERTS, NUM_PUSH_ACTIVE_SET_ENTRIES,
                          RECEIVED_CACHE_CAPACITY)
 
 
+def _resolve_prune_cap(trace_prune_cap: int, num_nodes: int,
+                       rc_slots: int) -> int:
+    """Flight-recorder prune-pair capture width (0 = auto 16*N, never more
+    than the theoretical N*rc_slots maximum) — the single source both
+    EngineParams and EngineStatic resolve through."""
+    cap = trace_prune_cap or 16 * num_nodes
+    return min(cap, num_nodes * rc_slots)
+
+
+def _resolve_k_inbound(inbound_cap: int, push_fanout: int) -> int:
+    """Inbound ranking width (0 = auto-size from the fanout)."""
+    if inbound_cap > 0:
+        return inbound_cap
+    return max(16, 2 * push_fanout)
+
+
+class EngineKnobs(NamedTuple):
+    """Dynamic numeric knobs, traced into the compiled round.
+
+    Each leaf is a fixed-dtype numpy scalar so every sweep step presents
+    the identical abstract value (shape ``()``, same dtype) to the jit
+    cache — the executable compiled for step 1 serves steps 2..K.
+    Construct via :meth:`EngineParams.split`.
+    """
+
+    probability_of_rotation: np.float32   # gossip_main.rs:124
+    prune_stake_threshold: np.float64     # received_cache.rs:112-115 (f64)
+    min_ingress_nodes: np.int32           # gossip_main.rs:135
+    warm_up_rounds: np.int32              # measured-round boundary
+    fail_at: np.int32                     # --when-to-fail; -1 = never
+    fail_fraction: np.float64             # --fraction-to-fail (host double)
+    packet_loss_rate: np.float64          # faults.py Bernoulli rates: the
+    churn_fail_rate: np.float64           # u32 thresholds derive from f64
+    churn_recover_rate: np.float64        # products exactly like the oracle
+    partition_at: np.int32                # bipartition window start
+    heal_at: np.int32                     # bipartition window end (-1 never)
+    impair_seed: np.uint32                # counter-hash seed (faults.py)
+
+
+class EngineStatic(NamedTuple):
+    """Static compile geometry: array shapes, ranking widths, and the
+    coarse booleans selecting which impairment blocks exist in the graph.
+    Hashable — this tuple (plus array shapes/dtypes) IS the jit cache key;
+    changing any field compiles a new executable."""
+
+    num_nodes: int
+    push_fanout: int
+    active_set_size: int
+    min_num_upserts: int
+    received_cap: int
+    rc_slots: int
+    inbound_cap: int
+    hist_bins: int
+    rot_tries: int
+    init_draws: int
+    pa_slots: int
+    trace_prune_cap: int
+    # Coarse graph-selection gates.  With all four False the compiled round
+    # is the exact unimpaired reference graph; a knob crossing its on/off
+    # boundary (e.g. packet_loss_rate 0 -> 0.1) flips a gate and recompiles
+    # once, after which any further numeric stepping is compile-free.
+    has_fail: bool = False
+    has_loss: bool = False
+    has_churn: bool = False
+    has_partition: bool = False
+
+    @property
+    def num_buckets(self) -> int:
+        return NUM_PUSH_ACTIVE_SET_ENTRIES
+
+    @property
+    def has_impairments(self) -> bool:
+        return self.has_loss or self.has_churn or self.has_partition
+
+    @property
+    def prune_cap(self) -> int:
+        return _resolve_prune_cap(self.trace_prune_cap, self.num_nodes,
+                                  self.rc_slots)
+
+    @property
+    def k_inbound(self) -> int:
+        return _resolve_k_inbound(self.inbound_cap, self.push_fanout)
+
+
 class EngineParams(NamedTuple):
-    """Static (compile-time) simulation parameters."""
+    """The full user-facing parameter set (static + dynamic, concrete)."""
 
     num_nodes: int
     push_fanout: int = 6                 # gossip_main.rs:90
@@ -34,7 +143,7 @@ class EngineParams(NamedTuple):
     # stateless counter hashes of (impair_seed, iteration, node ids), shared
     # bit-exactly with the oracle's FaultInjector.  With every knob at its
     # default the compiled round is IDENTICAL to the unimpaired engine
-    # (the blocks are gated on these static fields).
+    # (the blocks are gated on the EngineStatic booleans derived here).
     packet_loss_rate: float = 0.0    # per-message Bernoulli drop probability
     churn_fail_rate: float = 0.0     # per-iteration P(alive node fails)
     churn_recover_rate: float = 0.0  # per-iteration P(failed node recovers)
@@ -83,17 +192,57 @@ class EngineParams(NamedTuple):
         """Resolved flight-recorder prune-pair capture width per round
         (``trace_prune_cap``; 0 = auto: 16*num_nodes, never more than the
         theoretical N*rc_slots maximum)."""
-        cap = self.trace_prune_cap or 16 * self.num_nodes
-        return min(cap, self.num_nodes * self.rc_slots)
+        return _resolve_prune_cap(self.trace_prune_cap, self.num_nodes,
+                                  self.rc_slots)
 
     @property
     def k_inbound(self) -> int:
         """Resolved inbound ranking width (``inbound_cap``; 0 = auto-size
         from the fanout).  Truncation beyond this is counted per round in
         ``rows["inb_dropped"]`` and warned about by the CLI."""
-        if self.inbound_cap > 0:
-            return self.inbound_cap
-        return max(16, 2 * self.push_fanout)
+        return _resolve_k_inbound(self.inbound_cap, self.push_fanout)
+
+    def static_part(self) -> EngineStatic:
+        """The hashable compile key this parameter set selects."""
+        return EngineStatic(
+            num_nodes=self.num_nodes,
+            push_fanout=self.push_fanout,
+            active_set_size=self.active_set_size,
+            min_num_upserts=self.min_num_upserts,
+            received_cap=self.received_cap,
+            rc_slots=self.rc_slots,
+            inbound_cap=self.inbound_cap,
+            hist_bins=self.hist_bins,
+            rot_tries=self.rot_tries,
+            init_draws=self.init_draws,
+            pa_slots=self.pa_slots,
+            trace_prune_cap=self.trace_prune_cap,
+            has_fail=self.fail_at >= 0 and self.fail_fraction > 0.0,
+            has_loss=self.packet_loss_rate > 0.0,
+            has_churn=self.has_churn,
+            has_partition=self.partition_at >= 0,
+        )
+
+    def knob_values(self) -> EngineKnobs:
+        """The dynamic knobs, canonicalized to their traced dtypes."""
+        return EngineKnobs(
+            probability_of_rotation=np.float32(self.probability_of_rotation),
+            prune_stake_threshold=np.float64(self.prune_stake_threshold),
+            min_ingress_nodes=np.int32(self.min_ingress_nodes),
+            warm_up_rounds=np.int32(self.warm_up_rounds),
+            fail_at=np.int32(self.fail_at),
+            fail_fraction=np.float64(self.fail_fraction),
+            packet_loss_rate=np.float64(self.packet_loss_rate),
+            churn_fail_rate=np.float64(self.churn_fail_rate),
+            churn_recover_rate=np.float64(self.churn_recover_rate),
+            partition_at=np.int32(self.partition_at),
+            heal_at=np.int32(self.heal_at),
+            impair_seed=np.uint32(self.impair_seed & 0xFFFFFFFF),
+        )
+
+    def split(self) -> tuple[EngineStatic, EngineKnobs]:
+        """(static compile key, traced knob pytree) — the jit boundary."""
+        return self.static_part(), self.knob_values()
 
     def validate(self) -> "EngineParams":
         assert self.num_nodes >= 2

@@ -73,11 +73,17 @@ def round_basis(seed: int, it: int, salt: int) -> int:
     return fmix32((seed & _M32) ^ fmix32((it * _GOLD + salt) & _M32))
 
 
-def round_basis_arr(seed: int, it, salt: int, xp=np):
-    """``round_basis`` with a (possibly traced) uint32 iteration scalar."""
+def round_basis_arr(seed, it, salt: int, xp=np):
+    """``round_basis`` with (possibly traced) uint32 seed/iteration scalars.
+
+    The engine passes both the iteration counter and — since the dynamic-
+    knob split (engine/params.py EngineKnobs) — the impairment seed as
+    traced scalars, so a seed sweep reuses the compiled round."""
     itu = it.astype(xp.uint32) if hasattr(it, "astype") else xp.uint32(it & _M32)
     h = fmix32_arr(itu * xp.uint32(_GOLD) + xp.uint32(salt), xp)
-    return fmix32_arr(xp.uint32(seed & _M32) ^ h, xp)
+    seedu = (seed.astype(xp.uint32) if hasattr(seed, "astype")
+             else xp.uint32(seed & _M32))
+    return fmix32_arr(seedu ^ h, xp)
 
 
 def edge_u32(basis: int, src: int, dst: int) -> int:
@@ -110,6 +116,21 @@ def rate_threshold(rate: float) -> int:
     if rate >= 1.0:
         return 1 << 32
     return int(rate * (1 << 32))
+
+
+def rate_threshold_arr(rate, xp=np):
+    """``rate_threshold`` on a (possibly traced) float scalar -> u64.
+
+    The f64 product truncates toward zero under ``astype``, exactly like
+    the scalar path's ``int()`` (rates are nonnegative), so a traced rate
+    knob makes bit-identical Bernoulli decisions to the oracle's host
+    arithmetic.  Both endpoint exactness guarantees carry over: the
+    interior product never reaches 2^32, and the >= 1 branch returns the
+    64-bit threshold every u32 hash is below."""
+    r = rate.astype(xp.float64) if hasattr(rate, "astype") else xp.float64(rate)
+    t = (r * xp.float64(1 << 32)).astype(xp.uint64)
+    t = xp.where(r >= 1.0, xp.uint64(1 << 32), t)
+    return xp.where(r <= 0.0, xp.uint64(0), t)
 
 
 def partition_active(it: int, partition_at: int, heal_at: int) -> bool:

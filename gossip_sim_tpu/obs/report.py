@@ -11,16 +11,35 @@ span registry, plus nested sections the bench's one-liner omits:
 * ``config``       — the full simulation Config, JSON-safe
 * ``environment``  — python/jax versions, platform, device count, mesh
 * ``spans``        — every recorded span: ``{name: {total_s, count}}``
-* ``counters``     — raw counters (origin-iters, messages, ...)
+* ``counters``     — raw counters (origin-iters, messages, engine/compiles,
+                     engine/cache_hits, padded_sims, ...)
 * ``throughput``   — origin-iters/s (steady), messages/s, end-to-end wall
 * ``faults``       — delivered/dropped/suppressed totals when impaired
 * ``influx``       — points sent / dropped / retries / final queue depth
+* ``compilation_cache`` — persistent XLA cache dir + hit/miss counts
+                     (engine/cache.py; all-zero when never enabled)
+
+Compile-accounting counters (engine/core.py run_rounds; ISSUE 4):
+
+* ``engine/compiles``   — jitted round-scan executables built this run.
+                          A K-step sweep over any numeric EngineKnobs
+                          field reads exactly 1 here; shape/structure
+                          steps (fanout, active-set size) add one per
+                          distinct EngineStatic value.
+* ``engine/cache_hits`` — engine calls served by an already-compiled
+                          executable (sweep steps 2..K, later blocks).
+Both surface as flat top-level keys (``compiles``/``cache_hits``) so
+BENCH lines capture amortization, not just raw speed.
 
 Span-name conventions (shared by cli.py, bench.py, tools/):
 
 * ``ingest``          account source -> {pubkey: stake}
 * ``engine/tables``   make_cluster_tables
-* ``engine/init``     init_state (first device allocation)
+* ``engine/init``     init_state (first device allocation).  In the
+                      double-buffered --all-origins loop this times the
+                      host-side dispatch only — device init overlaps the
+                      previous batch's harvest, so all-origins init_s is
+                      smaller than a serialized run's
 * ``engine/compile``  the run's FIRST jitted rounds call (compile-
                       dominated; the warm-up scan in the CLI, the timing
                       warm-up in bench.py — same semantic as the
@@ -63,6 +82,8 @@ REQUIRED_KEYS = {
     "elapsed_s": (int, float),
     "init_s": (int, float),
     "compile_s": (int, float),
+    "compiles": int,
+    "cache_hits": int,
     "config": dict,
     "environment": dict,
     "spans": dict,
@@ -71,6 +92,7 @@ REQUIRED_KEYS = {
     "faults": dict,
     "influx": dict,
     "stats": dict,
+    "compilation_cache": dict,
 }
 
 
@@ -141,6 +163,8 @@ def _flat_summary(registry, *, platform: str, num_nodes: int,
         "elapsed_s": round(elapsed_s, 3),
         "init_s": round(init_s, 3),
         "compile_s": round(compile_s, 3),
+        "compiles": int(registry.counter("engine/compiles")),
+        "cache_hits": int(registry.counter("engine/cache_hits")),
     }
 
 
@@ -194,8 +218,20 @@ def build_run_report(config, registry, *, stats: dict | None = None,
         "faults": dict(faults or {}),
         "influx": dict(influx or {}),
         "stats": dict(stats or {}),
+        "compilation_cache": _compilation_cache_section(info),
     })
     return report
+
+
+def _compilation_cache_section(info: dict) -> dict:
+    """Persistent-cache accounting from registry info (the CLI/bench sync
+    engine/cache.py counters into ``info["persistent_cache"]``)."""
+    pc = info.get("persistent_cache") or {}
+    return {
+        "dir": str(info.get("compilation_cache_dir") or ""),
+        "hits": int(pc.get("hits", 0)),
+        "misses": int(pc.get("misses", 0)),
+    }
 
 
 def write_run_report(path: str, report: dict) -> None:

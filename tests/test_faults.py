@@ -254,6 +254,39 @@ class TestFaultParity:
         assert saw_drop and saw_sup and saw_churn
 
 
+class TestFaultParityDynamicKnobs(TestFaultParity):
+    """ISSUE 4: the same 1k-node oracle-vs-engine bit-exact check, but with
+    the engine's executable compiled for DIFFERENT knob values first — the
+    parity run is then a pure jit-cache hit with its knob values flowing in
+    as traced scalars, proving the dynamic-knob engine (not a per-value
+    recompile) matches the oracle bit-for-bit."""
+
+    N = 1024
+    ROUNDS = 6
+    SEED = 31
+    KNOBS = dict(packet_loss_rate=0.2, churn_fail_rate=0.03,
+                 churn_recover_rate=0.3, partition_at=1, heal_at=4)
+
+    def test_exact_parity_under_faults(self, pair):
+        from gossip_sim_tpu.engine import compiled_cache_size
+
+        (index, stakes_map, nodes, origin_pk,
+         tables, params, origins, state) = pair
+        # compile carrier: same static key, every numeric knob perturbed
+        warm = params._replace(packet_loss_rate=0.55, churn_fail_rate=0.2,
+                               churn_recover_rate=0.05, partition_at=2,
+                               heal_at=5, impair_seed=self.SEED + 7,
+                               prune_stake_threshold=0.4)
+        wstate = init_state(jax.random.PRNGKey(1), tables, origins, warm)
+        run_rounds(warm, tables, origins, wstate, self.ROUNDS, detail=True)
+        before = compiled_cache_size()
+        super().test_exact_parity_under_faults(pair)
+        if before >= 0:
+            assert compiled_cache_size() == before, (
+                "parity run recompiled instead of reusing the warm "
+                "executable with swapped knob values")
+
+
 class TestFaultParityLossOnly(TestFaultParity):
     """Loss without churn/partition takes the cheaper compiled path
     (no tfail rebuild, no side gather); parity must still hold."""

@@ -223,15 +223,16 @@ def test_validate_run_report_catches_problems():
 
 
 def test_bench_summary_matches_historical_bench_keys():
-    """BENCH trajectory compatibility: bench.py's line keeps its exact
-    key set, now sourced from the shared spans."""
+    """BENCH trajectory compatibility: bench.py's line keeps its historical
+    key set (sourced from the shared spans) plus the ISSUE-4 compile
+    accounting (compiles/cache_hits — amortization, not just raw speed)."""
     out = bench_summary(_fake_registry(), platform="cpu", num_nodes=1000,
                         origin_batch=8, iterations=100,
                         coverage_mean=0.994, rmr_mean=5.2)
     assert set(out) == {"metric", "value", "unit", "vs_baseline", "platform",
                         "num_nodes", "origin_batch", "iterations",
                         "elapsed_s", "init_s", "compile_s", "coverage_mean",
-                        "rmr_mean"}
+                        "rmr_mean", "compiles", "cache_hits"}
     assert out["value"] == pytest.approx(800 / 4.0)
     assert out["compile_s"] == pytest.approx(2.0)
 

@@ -1,0 +1,333 @@
+"""Compile-once sweep regression tests (ISSUE 4).
+
+The EngineParams split (engine/params.py): numeric knobs are traced
+EngineKnobs scalars, so stepping any of them across a sweep reuses one
+compiled executable; shape/structure fields remain the jit cache key.
+These tests pin the contract down:
+
+* the split itself (dtypes, static gate derivation),
+* a K-step numeric sweep compiles exactly once (cache-size delta AND the
+  engine/compiles / engine/cache_hits registry counters),
+* dynamic-knob results are bit-identical to fresh-compile runs,
+* shape knobs still recompile (the gates work both ways),
+* the persistent compilation cache round-trips executables through disk,
+* the CLI flag plumbs through.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_sim_tpu.engine import (EngineKnobs, EngineParams, EngineStatic,
+                                   clear_compile_cache, compiled_cache_size,
+                                   init_state, make_cluster_tables,
+                                   run_rounds)
+from gossip_sim_tpu.obs import get_registry
+
+
+def _cluster(n=96, seed=11):
+    rng = np.random.default_rng(seed)
+    stakes = rng.choice(np.arange(1, 50 * n), size=n,
+                        replace=False).astype(np.int64) * 10**9
+    return make_cluster_tables(stakes)
+
+
+def _fresh(params, tables, origins, rounds, key=3, **kw):
+    state = init_state(jax.random.PRNGKey(key), tables, origins, params)
+    state, rows = run_rounds(params, tables, origins, state, rounds, **kw)
+    return state, jax.tree_util.tree_map(np.asarray, rows)
+
+
+# --------------------------------------------------------------------------
+# the split
+# --------------------------------------------------------------------------
+
+class TestSplit:
+    def test_split_partitions_every_field(self):
+        """No EngineParams field may fall through the split: each one must
+        land in the static tuple or the knob pytree (a new field that does
+        neither would silently stop affecting the compiled engine)."""
+        static_fields = set(EngineStatic._fields) - {
+            "has_fail", "has_loss", "has_churn", "has_partition"}
+        knob_fields = set(EngineKnobs._fields)
+        assert static_fields | knob_fields == set(EngineParams._fields)
+        assert not static_fields & knob_fields
+
+    def test_knob_dtypes_fixed(self):
+        _, kn = EngineParams(num_nodes=10).split()
+        assert kn.probability_of_rotation.dtype == np.float32
+        for f in ("prune_stake_threshold", "fail_fraction",
+                  "packet_loss_rate", "churn_fail_rate",
+                  "churn_recover_rate"):
+            assert getattr(kn, f).dtype == np.float64, f
+        for f in ("min_ingress_nodes", "warm_up_rounds", "fail_at",
+                  "partition_at", "heal_at"):
+            assert getattr(kn, f).dtype == np.int32, f
+        assert kn.impair_seed.dtype == np.uint32
+
+    def test_static_gates_derive_from_knobs(self):
+        base = EngineParams(num_nodes=10)
+        st, _ = base.split()
+        assert not (st.has_fail or st.has_loss or st.has_churn
+                    or st.has_partition or st.has_impairments)
+        assert base._replace(packet_loss_rate=0.1).split()[0].has_loss
+        assert base._replace(churn_recover_rate=0.2).split()[0].has_churn
+        assert base._replace(partition_at=3).split()[0].has_partition
+        st_f = base._replace(fail_at=2, fail_fraction=0.1).split()[0]
+        assert st_f.has_fail and not st_f.has_impairments
+        # fail needs both the schedule and a nonzero fraction
+        assert not base._replace(fail_at=2).split()[0].has_fail
+
+    def test_numeric_steps_share_one_static_key(self):
+        base = EngineParams(num_nodes=10, packet_loss_rate=0.1)
+        stepped = base._replace(packet_loss_rate=0.3,
+                                probability_of_rotation=0.5,
+                                prune_stake_threshold=0.4,
+                                min_ingress_nodes=5, warm_up_rounds=7,
+                                impair_seed=99)
+        assert base.static_part() == stepped.static_part()
+        assert base._replace(push_fanout=9).static_part() != \
+            base.static_part()
+
+    def test_derived_properties_match_facade(self):
+        p = EngineParams(num_nodes=100, push_fanout=10, inbound_cap=0,
+                         trace_prune_cap=0)
+        st = p.static_part()
+        assert st.k_inbound == p.k_inbound == 20
+        assert st.prune_cap == p.prune_cap == 1600
+        assert st.num_buckets == p.num_buckets
+
+
+# --------------------------------------------------------------------------
+# recompile-count regression guard
+# --------------------------------------------------------------------------
+
+class TestCompileOnce:
+    N = 96
+    ROUNDS = 5
+
+    def test_four_step_numeric_sweep_compiles_exactly_once(self):
+        """The ISSUE-4 acceptance check: a 4-step sweep over a numeric
+        (non-shape) knob builds one executable, and the span registry
+        counts 1 compile + 3 cache hits for it."""
+        tables = _cluster(self.N)
+        origins = jnp.arange(2, dtype=jnp.int32)
+        base = EngineParams(num_nodes=self.N, warm_up_rounds=0,
+                            packet_loss_rate=0.05, impair_seed=5)
+        reg = get_registry()
+        clear_compile_cache()
+        before = compiled_cache_size()
+        c0 = reg.counter("engine/compiles")
+        h0 = reg.counter("engine/cache_hits")
+        for k in range(4):
+            _fresh(base._replace(packet_loss_rate=0.05 + 0.05 * k),
+                   tables, origins, self.ROUNDS)
+        assert compiled_cache_size() - before == 1
+        assert reg.counter("engine/compiles") - c0 == 1
+        assert reg.counter("engine/cache_hits") - h0 == 3
+
+    def test_every_knob_field_is_dynamic(self):
+        """Stepping EVERY EngineKnobs field at once (within the same gate
+        configuration) must not recompile."""
+        tables = _cluster(self.N)
+        origins = jnp.arange(1, dtype=jnp.int32)
+        base = EngineParams(num_nodes=self.N, warm_up_rounds=2,
+                            packet_loss_rate=0.1, churn_fail_rate=0.01,
+                            churn_recover_rate=0.2, partition_at=1,
+                            heal_at=3, fail_at=1, fail_fraction=0.05,
+                            impair_seed=1)
+        _fresh(base, tables, origins, self.ROUNDS)
+        before = compiled_cache_size()
+        stepped = base._replace(
+            probability_of_rotation=0.2, prune_stake_threshold=0.33,
+            min_ingress_nodes=4, warm_up_rounds=3, fail_at=2,
+            fail_fraction=0.21, packet_loss_rate=0.17, churn_fail_rate=0.03,
+            churn_recover_rate=0.4, partition_at=2, heal_at=4,
+            impair_seed=1234)
+        _fresh(stepped, tables, origins, self.ROUNDS)
+        assert compiled_cache_size() == before
+
+    def test_shape_knobs_still_recompile(self):
+        tables = _cluster(self.N)
+        origins = jnp.arange(1, dtype=jnp.int32)
+        base = EngineParams(num_nodes=self.N, warm_up_rounds=0)
+        _fresh(base, tables, origins, self.ROUNDS)
+        before = compiled_cache_size()
+        _fresh(base._replace(push_fanout=8), tables, origins, self.ROUNDS)
+        assert compiled_cache_size() == before + 1
+        # crossing an impairment on/off boundary flips a static gate: one
+        # more compile, after which stepping the rate is free again
+        _fresh(base._replace(packet_loss_rate=0.2), tables, origins,
+               self.ROUNDS)
+        assert compiled_cache_size() == before + 2
+        _fresh(base._replace(packet_loss_rate=0.4), tables, origins,
+               self.ROUNDS)
+        assert compiled_cache_size() == before + 2
+
+    def test_dynamic_knob_results_bit_identical_to_fresh_compile(self):
+        """A knob value run against a warm executable (compiled for a
+        DIFFERENT value) must produce bit-identical rows and state to a
+        fresh compile of that very value."""
+        tables = _cluster(self.N)
+        origins = jnp.arange(2, dtype=jnp.int32)
+        base = EngineParams(num_nodes=self.N, warm_up_rounds=0,
+                            packet_loss_rate=0.25, churn_fail_rate=0.02,
+                            churn_recover_rate=0.3, partition_at=1,
+                            heal_at=4, impair_seed=9)
+        target = base._replace(packet_loss_rate=0.12,
+                               probability_of_rotation=0.05,
+                               prune_stake_threshold=0.2, impair_seed=21)
+        _fresh(base, tables, origins, self.ROUNDS, detail=True)  # carrier
+        before = compiled_cache_size()
+        s_warm, r_warm = _fresh(target, tables, origins, self.ROUNDS,
+                                detail=True)
+        assert compiled_cache_size() == before, "knob step recompiled"
+        clear_compile_cache()
+        s_cold, r_cold = _fresh(target, tables, origins, self.ROUNDS,
+                                detail=True)
+        for k in r_cold:
+            np.testing.assert_array_equal(r_warm[k], r_cold[k], err_msg=k)
+        for f in s_cold._fields:
+            np.testing.assert_array_equal(np.asarray(getattr(s_warm, f)),
+                                          np.asarray(getattr(s_cold, f)),
+                                          err_msg=f)
+
+
+# --------------------------------------------------------------------------
+# persistent compilation cache
+# --------------------------------------------------------------------------
+
+def test_persistent_cache_round_trips_executables(tmp_path):
+    """Enabling the cache writes executables to disk on compile (misses)
+    and serves an identical program from disk after the in-memory cache is
+    dropped (hits)."""
+    import jax as _jax
+
+    from gossip_sim_tpu.engine import (enable_persistent_cache,
+                                       persistent_cache_counters)
+
+    cc = str(tmp_path / "cc")
+    try:
+        assert enable_persistent_cache(cc) == cc
+        tables = _cluster(48)
+        origins = jnp.arange(1, dtype=jnp.int32)
+        params = EngineParams(num_nodes=48, warm_up_rounds=0,
+                              probability_of_rotation=0.9)
+        clear_compile_cache()
+        c0 = persistent_cache_counters()
+        _, rows1 = _fresh(params, tables, origins, 3)
+        c1 = persistent_cache_counters()
+        assert c1["misses"] > c0["misses"]
+        assert os.listdir(cc), "no cache entries written"
+        # drop the in-memory executable; the disk cache must serve it
+        clear_compile_cache()
+        _, rows2 = _fresh(params, tables, origins, 3)
+        c2 = persistent_cache_counters()
+        assert c2["hits"] > c1["hits"]
+        for k in rows1:
+            np.testing.assert_array_equal(rows1[k], rows2[k], err_msg=k)
+    finally:
+        # leave no process-wide cache state behind for later tests
+        _jax.config.update("jax_compilation_cache_dir", None)
+        from gossip_sim_tpu.engine import cache as _cache_mod
+        _cache_mod._enabled_dir = None
+
+
+def test_cli_compilation_cache_flag_plumbs_through(tmp_path):
+    from gossip_sim_tpu.cli import build_parser, config_from_args
+
+    args = build_parser().parse_args(
+        ["--compilation-cache-dir", str(tmp_path)])
+    cfg = config_from_args(args)
+    assert cfg.compilation_cache_dir == str(tmp_path)
+    assert config_from_args(
+        build_parser().parse_args([])).compilation_cache_dir == ""
+
+
+def test_run_report_carries_compile_accounting(tmp_path):
+    """--run-report surfaces compiles/cache_hits flat keys and the
+    compilation_cache section (schema-valid)."""
+    from gossip_sim_tpu.cli import main as cli_main
+    from gossip_sim_tpu.obs.report import validate_run_report
+    import json
+
+    report_path = str(tmp_path / "report.json")
+    rc = cli_main(["--num-synthetic-nodes", "40", "--iterations", "4",
+                   "--warm-up-rounds", "2", "--backend", "tpu",
+                   "--run-report", report_path])
+    assert rc == 0
+    with open(report_path) as f:
+        report = json.load(f)
+    assert validate_run_report(report) == []
+    assert report["compiles"] >= 1
+    assert report["counters"]["engine/compiles"] >= 1
+    assert set(report["compilation_cache"]) == {"dir", "hits", "misses"}
+
+
+# --------------------------------------------------------------------------
+# knobs override argument
+# --------------------------------------------------------------------------
+
+def test_run_rounds_explicit_knobs_override():
+    """run_rounds(knobs=...) overrides the values embedded in params —
+    the hook sweeps use to step a knob without rebuilding EngineParams."""
+    tables = _cluster(48)
+    origins = jnp.arange(1, dtype=jnp.int32)
+    loud = EngineParams(num_nodes=48, warm_up_rounds=0,
+                        probability_of_rotation=1.0)
+    quiet = loud._replace(probability_of_rotation=0.0)
+    _, r_override = _fresh(loud, tables, origins, 4,
+                           knobs=quiet.knob_values())
+    _, r_quiet = _fresh(quiet, tables, origins, 4)
+    for k in r_quiet:
+        np.testing.assert_array_equal(r_override[k], r_quiet[k], err_msg=k)
+
+
+def test_explicit_knobs_gate_mismatch_raises():
+    """A knob override activating an impairment the compile key gates OUT
+    would be silently ignored by the compiled graph; the boundary must
+    reject it instead of simulating wrong physics."""
+    tables = _cluster(48)
+    origins = jnp.arange(1, dtype=jnp.int32)
+    lossless = EngineParams(num_nodes=48, warm_up_rounds=0)
+    lossy_knobs = lossless._replace(packet_loss_rate=0.3).knob_values()
+    state = init_state(jax.random.PRNGKey(0), tables, origins, lossless)
+    with pytest.raises(ValueError, match="has_loss"):
+        run_rounds(lossless, tables, origins, state, 2, knobs=lossy_knobs)
+
+
+def test_zero_knobs_against_gated_graph_bit_identical_to_unimpaired():
+    """The safe direction is allowed and exact: off/zero knob values run
+    through a fully impairment-gated graph must reproduce the unimpaired
+    engine bit-for-bit (a knobs= sweep can include its 0 endpoint without
+    recompiling) — including partition_at = -1, whose off endpoint the
+    traced window test must honor."""
+    tables = _cluster(48)
+    origins = jnp.arange(2, dtype=jnp.int32)
+    gated = EngineParams(num_nodes=48, warm_up_rounds=0,
+                         packet_loss_rate=0.2, churn_fail_rate=0.05,
+                         churn_recover_rate=0.3, partition_at=1, heal_at=3,
+                         impair_seed=4)
+    off = gated._replace(packet_loss_rate=0.0, churn_fail_rate=0.0,
+                         churn_recover_rate=0.0, partition_at=-1,
+                         heal_at=-1)
+    plain = EngineParams(num_nodes=48, warm_up_rounds=0)
+    assert gated.static_part().has_impairments
+    _, r_off = _fresh(gated, tables, origins, 6, knobs=off.knob_values())
+    _, r_plain = _fresh(plain, tables, origins, 6)
+    for k in r_plain:
+        np.testing.assert_array_equal(r_off[k], r_plain[k], err_msg=k)
+
+
+def test_round_step_static_requires_knobs():
+    tables = _cluster(48)
+    origins = jnp.arange(1, dtype=jnp.int32)
+    params = EngineParams(num_nodes=48, warm_up_rounds=0)
+    state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+    from gossip_sim_tpu.engine import round_step
+    with pytest.raises(TypeError, match="knobs"):
+        round_step(params.static_part(), tables, origins, state,
+                   jnp.int32(0))

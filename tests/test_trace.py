@@ -115,6 +115,35 @@ class TestEngineTraceRows:
                                  detail=True, trace=True)
         return params, jax.tree_util.tree_map(np.asarray, rows)
 
+    def test_trace_rows_bit_identical_after_knob_swap_without_recompile(self):
+        """ISSUE 4: flight-recorder rows from a warm executable (compiled
+        for different knob values) match a fresh compile of the target
+        values bit-for-bit — the trace capture itself is knob-dynamic."""
+        from gossip_sim_tpu.engine import (clear_compile_cache,
+                                           compiled_cache_size)
+
+        warm_kw = dict(packet_loss_rate=0.3, impair_seed=5,
+                       probability_of_rotation=0.4)
+        target_kw = dict(packet_loss_rate=0.1, impair_seed=12,
+                         probability_of_rotation=0.1)
+        tables, params, origins, state = _engine_setup(o=2, **warm_kw)
+        run_rounds(params, tables, origins, state, 6, detail=True,
+                   trace=True)                              # compile carrier
+        before = compiled_cache_size()
+        tables, params, origins, state = _engine_setup(o=2, **target_kw)
+        _, r_warm = run_rounds(params, tables, origins, state, 6,
+                               detail=True, trace=True)
+        r_warm = jax.tree_util.tree_map(np.asarray, r_warm)
+        if before >= 0:
+            assert compiled_cache_size() == before, "knob swap recompiled"
+        clear_compile_cache()
+        tables, params, origins, state = _engine_setup(o=2, **target_kw)
+        _, r_cold = run_rounds(params, tables, origins, state, 6,
+                               detail=True, trace=True)
+        r_cold = jax.tree_util.tree_map(np.asarray, r_cold)
+        for k in r_cold:
+            np.testing.assert_array_equal(r_warm[k], r_cold[k], err_msg=k)
+
     def test_trace_flag_changes_no_simulation_bits(self):
         tables, params, origins, state = _engine_setup(o=2)
         s1, r1 = run_rounds(params, tables, origins, state, 6, detail=True,

@@ -1,0 +1,142 @@
+"""Sweep smoke test: the compile-once sweep contract as a CI gate.
+
+A 3-step packet-loss sweep at n=1000 on the jitted engine (the sweep
+harness pattern, gossip_main.rs:774-951), asserting the ISSUE-4 contract:
+
+  1. **one compile total** — stepping a numeric EngineKnobs field across
+     K sims builds exactly one round-scan executable (steps 2..K are
+     jit-cache hits), and the span registry records engine/compiles == 1
+     with K-1 engine/cache_hits;
+  2. **bit-exactness** — every engine row of every sweep step is
+     bit-identical to a per-sim fresh-compile run of the same parameters
+     (the compiled-once executable computes exactly what K independent
+     compiles would);
+  3. **amortization is real** — wall-clock of each warm step 2..K stays
+     below --max-warm-fraction of step 1 (which carries the compile).
+
+Usage: python tools/sweep_smoke.py [--num-nodes 1000] [--steps 3]
+       [--iterations 10] [--seed 7] [--loss-start 0.05] [--loss-step 0.05]
+       [--max-warm-fraction 0.5]
+
+Exit code 0 = all assertions hold; 1 = the compile-once contract broke.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="compile-once sweep CI gate (CPU, <60s)")
+    ap.add_argument("--num-nodes", type=int, default=1000)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--iterations", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--loss-start", type=float, default=0.05)
+    ap.add_argument("--loss-step", type=float, default=0.05)
+    ap.add_argument("--max-warm-fraction", type=float, default=0.5,
+                    help="each warm step's wall time must stay below this "
+                         "fraction of step 1 (which carries the compile)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossip_sim_tpu.engine import (EngineParams, clear_compile_cache,
+                                       compiled_cache_size, init_state,
+                                       make_cluster_tables, run_rounds)
+    from gossip_sim_tpu.obs import get_registry
+
+    t0 = time.time()
+    n, K = args.num_nodes, args.steps
+    rng = np.random.default_rng(args.seed)
+    stakes = rng.choice(np.arange(1, 50 * n), size=n,
+                        replace=False).astype(np.int64) * 10**9
+    tables = make_cluster_tables(stakes)
+    origins = jnp.arange(1, dtype=jnp.int32)
+    rates = [args.loss_start + k * args.loss_step for k in range(K)]
+    step_params = [
+        EngineParams(num_nodes=n, warm_up_rounds=0, impair_seed=args.seed,
+                     packet_loss_rate=r).validate()
+        for r in rates]
+
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    print(f"sweep smoke: n={n} K={K} packet-loss rates={rates} "
+          f"iters={args.iterations}")
+
+    # ---- sweep arm: K steps against one executable ---------------------
+    reg = get_registry()
+    reg.reset()
+    clear_compile_cache()
+    cache0 = compiled_cache_size()
+    times, sweep_rows = [], []
+    for k, params in enumerate(step_params):
+        t_step = time.perf_counter()
+        state = init_state(jax.random.PRNGKey(args.seed), tables, origins,
+                           params)
+        state, rows = run_rounds(params, tables, origins, state,
+                                 args.iterations)
+        rows = jax.tree_util.tree_map(np.asarray, rows)
+        times.append(time.perf_counter() - t_step)
+        sweep_rows.append(rows)
+    cache_delta = compiled_cache_size() - cache0
+    print(f"  step wall times: {[round(t, 3) for t in times]} s")
+
+    check(cache_delta == 1,
+          f"exactly one compiled executable across {K} steps "
+          f"(got {cache_delta})")
+    check(int(reg.counter("engine/compiles")) == 1,
+          f"registry engine/compiles == 1 "
+          f"(got {int(reg.counter('engine/compiles'))})")
+    check(int(reg.counter("engine/cache_hits")) == K - 1,
+          f"registry engine/cache_hits == {K - 1} "
+          f"(got {int(reg.counter('engine/cache_hits'))})")
+
+    warm_ok = all(t <= args.max_warm_fraction * times[0] for t in times[1:])
+    check(warm_ok,
+          f"warm steps 2..{K} each below {args.max_warm_fraction:.2f}x of "
+          f"step 1 ({times[0]:.3f}s)")
+
+    # the sweep actually spanned distinct regimes
+    drop_totals = [int(r["dropped"].sum()) for r in sweep_rows]
+    check(all(b > a for a, b in zip(drop_totals, drop_totals[1:])),
+          f"drop counts increase along the rate sweep ({drop_totals})")
+
+    # ---- reference arm: per-sim fresh-compile runs ---------------------
+    for k, params in enumerate(step_params):
+        clear_compile_cache()
+        state = init_state(jax.random.PRNGKey(args.seed), tables, origins,
+                           params)
+        state, rows = run_rounds(params, tables, origins, state,
+                                 args.iterations)
+        rows = jax.tree_util.tree_map(np.asarray, rows)
+        mismatched = [key for key in rows
+                      if not np.array_equal(rows[key], sweep_rows[k][key])]
+        check(not mismatched,
+              f"step {k + 1} bit-identical to its fresh-compile run"
+              + (f" (diverged: {mismatched})" if mismatched else ""))
+
+    dt = time.time() - t0
+    print(f"  elapsed: {dt:.1f}s")
+    if failures:
+        print(f"SWEEP SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("SWEEP SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
