@@ -22,7 +22,11 @@ registry (gossip_sim_tpu/obs/) — the same spans ``--run-report`` emits —
 so BENCH trajectory lines and product run reports are directly comparable.
 A slow-waking TPU gets more than one probe window via
 ``GOSSIP_BENCH_PROBE_TIMEOUT`` (seconds per attempt, default 150) and
-``GOSSIP_BENCH_PROBE_TRIES`` (attempts, default 3).
+``GOSSIP_BENCH_PROBE_TRIES`` (attempts, default 3) — but a probe that
+*hangs* to the hard timeout is not retried, and the failure is cached on
+disk (``GOSSIP_BENCH_PROBE_CACHE``, TTL ``GOSSIP_BENCH_PROBE_CACHE_TTL``)
+so an unavailable accelerator costs one timeout per cache window instead
+of three per run.
 """
 
 import argparse
@@ -193,14 +197,78 @@ def _run_sub(cmd, timeout, env=None):
         return -9, "", f"TIMEOUT after {timeout}s; stderr tail: {err[-1500:]}"
 
 
+def _probe_cache_path():
+    """Failed-probe cache file (``GOSSIP_BENCH_PROBE_CACHE``; "0"/"off"
+    disables, unset = a stable per-user temp path)."""
+    import tempfile
+    v = os.environ.get("GOSSIP_BENCH_PROBE_CACHE", "")
+    if v.lower() in ("0", "off", "none"):
+        return None
+    if v:
+        return v
+    return os.path.join(tempfile.gettempdir(),
+                        f"gossip-sim-probe-cache-{os.getuid()}.json")
+
+
+PROBE_CACHE_TTL = max(0.0, _env_number("GOSSIP_BENCH_PROBE_CACHE_TTL",
+                                       1800.0, float))
+
+
+def _read_probe_cache():
+    """-> age_seconds of a cached probe FAILURE, or None."""
+    path = _probe_cache_path()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            entry = json.load(f)
+        age = time.time() - float(entry["ts"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    return age if 0 <= age < PROBE_CACHE_TTL else None
+
+
+def _write_probe_cache():
+    path = _probe_cache_path()
+    if not path:
+        return
+    try:
+        with open(path, "w") as f:
+            json.dump({"ts": time.time(), "platform": None}, f)
+    except OSError:
+        pass
+
+
 def probe_backend():
-    """Ask a subprocess what jax.devices() says. Retries on failure/hang.
+    """Ask a subprocess what jax.devices() says.
+
+    Failure handling (round-6: an unavailable TPU must cost ONE timeout,
+    not PROBE_RETRIES of them — BENCH_r05 burned 3 x 150 s on a hung
+    backend before falling back to CPU):
+
+    * a probe that HANGS (hard timeout) is not retried — a backend that
+      cannot answer ``jax.devices()`` in PROBE_TIMEOUT seconds will not be
+      healed by a 10 s pause; fast non-timeout errors still get the full
+      retry budget;
+    * the failure is cached on disk (``GOSSIP_BENCH_PROBE_CACHE``, TTL
+      ``GOSSIP_BENCH_PROBE_CACHE_TTL`` = 1800 s) so repeat bench
+      invocations inside the window skip the probe entirely and go
+      straight to the CPU fallback rung.  Successes are never cached — a
+      freshly-revived accelerator is always picked up.
 
     Returns (platform_or_None, diagnostics list)."""
     code = ("import jax, json; d = jax.devices(); "
             "print(json.dumps({'platform': d[0].platform, 'n': len(d), "
             "'version': jax.__version__}))")
     diags = []
+    cached_age = _read_probe_cache()
+    if cached_age is not None:
+        diags.append(
+            f"probe skipped: cached failure {round(cached_age)}s ago "
+            f"(< ttl {round(PROBE_CACHE_TTL)}s; delete "
+            f"{_probe_cache_path()} or set GOSSIP_BENCH_PROBE_CACHE=off "
+            f"to force a probe)")
+        return None, diags
     for attempt in range(PROBE_RETRIES):
         t0 = time.time()
         rc, out, err = _run_sub([sys.executable, "-c", code], PROBE_TIMEOUT)
@@ -214,8 +282,13 @@ def probe_backend():
                 diags.append(f"probe[{attempt}] unparseable ({e}): {out[:200]}")
         else:
             diags.append(f"probe[{attempt}] rc={rc} in {dt}s: {err[-300:]}")
+        if rc == -9:
+            diags.append("probe hung to the hard timeout; not retrying "
+                         "(a hung backend does not heal in seconds)")
+            break
         if attempt < PROBE_RETRIES - 1:
             time.sleep(min(10 * (attempt + 1), 30))
+    _write_probe_cache()
     return None, diags
 
 

@@ -22,7 +22,7 @@ from .engine.params import EngineParams
 
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 3
+_FORMAT_VERSION = 4
 # v1 checkpoints predate the tfail/rc_shi/rc_slo SimState fields; all three
 # are derivable from active/failed/rc_src plus the cluster stake table, so
 # v1 files remain loadable when ``tables`` is passed to restore_sim_state.
@@ -32,8 +32,12 @@ _FORMAT_VERSION = 3
 # hash of (impair_seed, iteration, node ids), no extra *array* state is
 # needed for bit-exact resumption mid-churn — the ``failed`` mask (already
 # stored) plus the recorded knobs fully determine the continuation.  v2
-# files backfill an all-off impair block on load.
-_READABLE_VERSIONS = (1, 2, 3)
+# files backfill an all-off impair block on load.  v4 adds the pull-gossip
+# subsystem (pull.py): the ``pull_hops_hist_acc``/``pull_rescued_acc``
+# accumulators and a ``pull`` meta block; pre-v4 files were written by the
+# push-only engine, so both accumulators backfill as zeros (exact — no
+# pull rounds ever ran) and the pull block as mode "push".
+_READABLE_VERSIONS = (1, 2, 3, 4)
 
 # EngineParams fields that define array shapes; a mismatch makes the stored
 # state unusable under the new compile geometry.
@@ -47,6 +51,13 @@ _IMPAIR_FIELDS = ("packet_loss_rate", "churn_fail_rate",
                   "impair_seed")
 _IMPAIR_DEFAULTS = {f: EngineParams._field_defaults[f]
                     for f in _IMPAIR_FIELDS}
+
+# EngineParams fields describing the pull-gossip schedule (v4 meta block);
+# like the impair block, the stateless counter hashes mean the recorded
+# knobs + the stored state fully determine a bit-exact continuation.
+_PULL_FIELDS = ("gossip_mode", "pull_fanout", "pull_interval",
+                "pull_bloom_fp_rate", "pull_request_cap")
+_PULL_DEFAULTS = {f: EngineParams._field_defaults[f] for f in _PULL_FIELDS}
 
 
 def save_state(path: str, state, params, config=None,
@@ -64,6 +75,7 @@ def save_state(path: str, state, params, config=None,
         "params": pdict,
         "impair": {f: pdict.get(f, _IMPAIR_DEFAULTS[f])
                    for f in _IMPAIR_FIELDS},
+        "pull": {f: pdict.get(f, _PULL_DEFAULTS[f]) for f in _PULL_FIELDS},
         "iteration": int(iteration),
     }
     if config is not None:
@@ -105,8 +117,10 @@ def load_state(path: str, params=None):
         arrays = {k[len("state."):]: z[k] for k in z.files
                   if k.startswith("state.")}
     stored = meta["params"]
-    # pre-v3 backfill: impairment knobs default to all-off
+    # pre-v3 backfill: impairment knobs default to all-off; pre-v4: the
+    # push-only mode
     meta.setdefault("impair", dict(_IMPAIR_DEFAULTS))
+    meta.setdefault("pull", dict(_PULL_DEFAULTS))
     if params is not None:
         for f in _SHAPE_FIELDS:
             if getattr(params, f) != stored[f]:
@@ -120,6 +134,14 @@ def load_state(path: str, params=None):
                     "diverges from the original run",
                     f, getattr(params, f, _IMPAIR_DEFAULTS[f]),
                     meta["impair"][f])
+        for f in _PULL_FIELDS:
+            if getattr(params, f, _PULL_DEFAULTS[f]) != meta["pull"][f]:
+                log.warning(
+                    "WARNING: resuming with %s=%s but checkpoint was written "
+                    "with %s — the continuation's pull schedule diverges "
+                    "from the original run",
+                    f, getattr(params, f, _PULL_DEFAULTS[f]),
+                    meta["pull"][f])
     return arrays, stored, meta
 
 
@@ -135,6 +157,18 @@ def restore_sim_state(path: str, params=None, tables=None):
 
     arrays, stored, meta = load_state(path, params)
     missing = set(SimState._fields) - set(arrays)
+    # pre-v4 files were written by the push-only engine: the pull
+    # accumulators are exactly zero (no pull round ever ran)
+    pull_fields = {"pull_hops_hist_acc", "pull_rescued_acc"}
+    if missing & pull_fields:
+        o, n = arrays["failed"].shape
+        h = int(stored.get("hist_bins",
+                           EngineParams._field_defaults["hist_bins"]))
+        if "pull_hops_hist_acc" in missing:
+            arrays["pull_hops_hist_acc"] = np.zeros((o, h), np.int32)
+        if "pull_rescued_acc" in missing:
+            arrays["pull_rescued_acc"] = np.zeros((o, n), np.int32)
+        missing = set(SimState._fields) - set(arrays)
     derivable = {"tfail", "rc_shi", "rc_slo"}
     if missing and missing <= derivable and tables is not None:
         n = stored["num_nodes"]

@@ -127,6 +127,29 @@ def _impair_params(config) -> dict:
                 impair_seed=config.seed)
 
 
+def _pull_params(config) -> dict:
+    """EngineParams kwargs for the pull-gossip knobs (pull.py)."""
+    return dict(gossip_mode=config.gossip_mode,
+                pull_fanout=config.pull_fanout,
+                pull_interval=config.pull_interval,
+                pull_bloom_fp_rate=config.pull_bloom_fp_rate,
+                pull_request_cap=config.pull_request_cap)
+
+
+def _make_pull_oracle(config, index):
+    """Oracle-side pull driver (pull.PullOracle), or None for push mode."""
+    if not config.has_pull:
+        return None
+    from .pull import PullOracle
+    return PullOracle(
+        index.stakes.astype(np.int64), seed=config.seed,
+        pull_fanout=config.pull_fanout, pull_interval=config.pull_interval,
+        pull_bloom_fp_rate=config.pull_bloom_fp_rate,
+        pull_request_cap=config.pull_request_cap,
+        packet_loss_rate=config.packet_loss_rate,
+        partition_at=config.partition_at, heal_at=config.heal_at)
+
+
 def _make_trace_writer(config, index, origin_indices, *, backend,
                        params=None):
     """Flight-recorder writer for ``--trace-dir`` (obs/trace.py), or None
@@ -149,13 +172,16 @@ def _make_trace_writer(config, index, origin_indices, *, backend,
     fanout = min(config.gossip_push_fanout, config.gossip_active_set_size)
     if params is None:
         params = EngineParams(num_nodes=len(index),
-                              trace_prune_cap=config.trace_prune_cap)
+                              trace_prune_cap=config.trace_prune_cap,
+                              **_pull_params(config))
     prune_cap = params.prune_cap
     return TraceWriter(
         config.trace_dir, backend=backend, num_nodes=len(index),
         push_fanout=fanout,
         active_set_size=config.gossip_active_set_size,
         prune_cap=prune_cap,
+        gossip_mode=params.gossip_mode,
+        pull_slots=(params.pull_slots_resolved if params.has_pull else 0),
         origins=[int(i) for i in origin_indices],
         origin_pubkeys=[index.pubkeys[int(i)].to_string()
                         for i in origin_indices],
@@ -230,6 +256,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "(-1 = never)")
     p.add_argument("--heal-at", type=int, default=-1,
                    help="iteration at which the partition heals (-1 = never)")
+    # ---- pull gossip / anti-entropy (pull.py) ---------------------------
+    p.add_argument("--gossip-mode", default="push",
+                   choices=["push", "pull", "push-pull"],
+                   help="protocol phases to simulate: push (the reference "
+                        "protocol; default, bit-identical to the push-only "
+                        "simulator), pull (anti-entropy only), or "
+                        "push-pull (both; pull rescues push-stranded "
+                        "nodes)")
+    p.add_argument("--pull-fanout", type=int, default=2,
+                   help="pull requests each live node sends per pull round "
+                        "(stake-weighted peer sampling)")
+    p.add_argument("--pull-interval", type=int, default=1,
+                   help="rounds between pull exchanges (pull runs when "
+                        "iteration %% interval == 0)")
+    p.add_argument("--pull-bloom-fp-rate", type=float, default=0.1,
+                   help="bloom-filter false-positive probability of the "
+                        "pull request digest (a holder wrongly filters "
+                        "the value out; Solana's bloom targets 0.1)")
+    p.add_argument("--pull-request-cap", type=int, default=0,
+                   help="max pull requests a peer serves per round "
+                        "(<= 0 = unlimited); excess requests are counted "
+                        "as capped misses")
     p.add_argument("--influx", default="n",
                    help="Influx for reporting metrics. i for internal-metrics, "
                         "l for localhost, n for none")
@@ -252,6 +300,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh-devices", type=int, default=0,
                    help="devices to shard origin batches over in "
                         "--all-origins mode (0 = all available)")
+    p.add_argument("--mesh-node-shards", type=int, default=1,
+                   help="--all-origins mode: additionally shard the "
+                        "per-origin node axis over this many devices per "
+                        "origin-shard (parallel/mesh.py; must divide the "
+                        "mesh device count; 1 = origins axis only)")
     p.add_argument("--profile-dir", "--jax-profile", dest="jax_profile_dir",
                    default="", metavar="DIR",
                    help="tpu backend: capture a jax.profiler trace of the "
@@ -311,6 +364,15 @@ def config_from_args(args) -> Config:
         raise SystemExit("heal-at requires partition-at")
     if args.partition_at >= 0 and 0 <= args.heal_at < args.partition_at:
         raise SystemExit("heal-at must not precede partition-at")
+    if not 0.0 <= args.pull_bloom_fp_rate <= 1.0:
+        raise SystemExit("pull-bloom-fp-rate must be between 0 and 1")
+    if args.gossip_mode != "push":
+        if args.pull_fanout < 1:
+            raise SystemExit("pull-fanout must be >= 1")
+        if args.pull_interval < 1:
+            raise SystemExit("pull-interval must be >= 1")
+    if args.mesh_node_shards < 1:
+        raise SystemExit("mesh-node-shards must be >= 1")
     return Config(
         gossip_push_fanout=args.push_fanout,
         gossip_active_set_size=args.active_set_size,
@@ -332,6 +394,11 @@ def config_from_args(args) -> Config:
         churn_recover_rate=args.churn_recover_rate,
         partition_at=args.partition_at,
         heal_at=args.heal_at,
+        gossip_mode=args.gossip_mode,
+        pull_fanout=args.pull_fanout,
+        pull_interval=args.pull_interval,
+        pull_bloom_fp_rate=args.pull_bloom_fp_rate,
+        pull_request_cap=args.pull_request_cap,
         test_type=Testing.parse(args.test_type),
         num_simulations=args.num_simulations,
         step_size=StepSize.parse(args.step_size),
@@ -345,6 +412,7 @@ def config_from_args(args) -> Config:
         checkpoint_path=args.checkpoint_path,
         resume_path=args.resume_path,
         mesh_devices=args.mesh_devices,
+        mesh_node_shards=args.mesh_node_shards,
         jax_profile_dir=args.jax_profile_dir,
         run_report_path=args.run_report_path,
         trace_dir=args.trace_dir,
@@ -425,6 +493,7 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
     reg.set_info("platform", "oracle")
     rng = ChaChaRng.from_seed_byte(config.seed % 256)
     stakes = dict(accounts)
+    index = NodeIndex.from_stakes(accounts)
     nodes = [Node(pk, stake) for pk, stake in accounts.items()]
     node_map = {nd.pubkey: nd for nd in nodes}
     log.info("Simulating Gossip and setting active sets. Please wait.....")
@@ -439,16 +508,19 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
         # point, where it classifies every push as delivered
         from .faults import FaultInjector
         impair = FaultInjector(
-            NodeIndex.from_stakes(accounts), seed=config.seed,
+            index, seed=config.seed,
             packet_loss_rate=config.packet_loss_rate,
             churn_fail_rate=config.churn_fail_rate,
             churn_recover_rate=config.churn_recover_rate,
             partition_at=config.partition_at, heal_at=config.heal_at)
 
+    # pull (anti-entropy) phase driver (pull.py) — the identical stateless
+    # spec the engine's round/pull block implements
+    pull_oracle = _make_pull_oracle(config, index)
+
     tracer = collector = None
     if config.trace_dir:
         from .obs.trace import OracleTraceCollector
-        index = NodeIndex.from_stakes(accounts)
         tracer = _make_trace_writer(
             config, index, [index.index_of(origin_pubkey)],
             backend="oracle")
@@ -458,7 +530,9 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
                 push_fanout=min(config.gossip_push_fanout,
                                 config.gossip_active_set_size),
                 active_set_size=config.gossip_active_set_size,
-                prune_cap=tracer.manifest["prune_cap"])
+                prune_cap=tracer.manifest["prune_cap"],
+                gossip_mode=config.gossip_mode,
+                pull_slots=tracer.manifest["pull_slots"])
 
     def _flush_trace():
         flushed = collector.flush()
@@ -467,7 +541,10 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
                 seg = tracer.add_block(*flushed)
             _push_sim_trace_point(dp_queue, sim_iter, start_ts, seg)
 
-    cluster = Cluster(config.gossip_push_fanout)
+    # pull-only mode: the push phase emits nothing (fanout 0 truncates every
+    # push list), mirroring the engine's has_push=False gating
+    cluster = Cluster(config.gossip_push_fanout
+                      if config.gossip_mode != "pull" else 0)
     hb = Heartbeat(config.gossip_iterations, label="oracle rounds",
                    unit="iter")
     for it in range(config.gossip_iterations):
@@ -489,6 +566,9 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
             # about to push through (the engine captures the same instant)
             collector.begin_round(cluster, node_map)
         cluster.run_gossip(origin_pubkey, stakes, node_map, impair)
+        if pull_oracle is not None:
+            # anti-entropy exchange against this round's push outcome
+            cluster.run_pull(pull_oracle, it, index, node_map)
         cluster.consume_messages(origin_pubkey, nodes)
         cluster.send_prunes(origin_pubkey, nodes, config.prune_stake_threshold,
                             config.min_ingress_nodes, stakes)
@@ -530,7 +610,7 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
                 log.warning("WARNING: poor coverage for origin: %s, %s",
                             origin_pubkey, coverage)
             stats.insert_coverage(coverage)
-            stats.insert_hops_stat(cluster.distances)
+            stats.insert_hops_stat(cluster.hops_with_pull())
             stats.insert_stranded_nodes(cluster.stranded_nodes(), stakes)
             stats.calculate_outbound_branching_factor(cluster.pushes)
             stats.update_message_counts(cluster.egress_message_count,
@@ -542,6 +622,11 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
                 stats.insert_delivery(impair.delivered, impair.dropped,
                                       impair.suppressed,
                                       len(cluster.failed_nodes))
+            if pull_oracle is not None:
+                pr = cluster.pull
+                stats.insert_pull(pr.requests, pr.responses, pr.misses,
+                                  pr.dropped, pr.suppressed,
+                                  len(pr.rescued))
             _push_iteration_points(config, dp_queue, sim_iter, start_ts,
                                    stats, steady, coverage, rmr_result)
             reg.record("stats/harvest", time.perf_counter() - t_h)
@@ -584,6 +669,7 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
                        if config.test_type == Testing.FAIL_NODES else 0.0),
         trace_prune_cap=config.trace_prune_cap,
         **_impair_params(config),
+        **_pull_params(config),
     )
     with reg.span("engine/tables"):
         tables = make_cluster_tables(index.stakes.astype(np.int64))
@@ -757,7 +843,12 @@ def _feed_measured_round(stats, rows, t, col, it, config, index, stakes,
     if coverage < POOR_COVERAGE_THRESHOLD:
         log.warning("WARNING: poor coverage for origin: %s, %s",
                     origin_pubkey, coverage)
-    dist = rows["dist"][t, col]            # [N], -1 = unreached
+    dist = rows["dist"][t, col]            # [N], -1 = unreached (push)
+    if "pull_hop" in rows:
+        # fold pull rescues into the per-node hop view (pull.py), exactly
+        # like the oracle's hops_with_pull()
+        ph = rows["pull_hop"][t, col]
+        dist = np.where(dist >= 0, dist, ph)
     hops = np.where(dist < 0, UNREACHED, dist.astype(np.uint64))
     stranded_mask = rows["stranded_mask"][t, col]
     stranded = [index.pubkeys[i] for i in np.nonzero(stranded_mask)[0]]
@@ -773,6 +864,13 @@ def _feed_measured_round(stats, rows, t, col, it, config, index, stakes,
                               int(rows["dropped"][t, col]),
                               int(rows["suppressed"][t, col]),
                               int(rows["failed_count"][t, col]))
+    if "pull_requests" in rows:
+        stats.insert_pull(int(rows["pull_requests"][t, col]),
+                          int(rows["pull_responses"][t, col]),
+                          int(rows["pull_misses"][t, col]),
+                          int(rows["pull_dropped"][t, col]),
+                          int(rows["pull_suppressed"][t, col]),
+                          int(rows["pull_rescued"][t, col]))
     _push_iteration_points(config, dp_queue, sim_iter, start_ts,
                            stats, steady, coverage, rmr_result)
 
@@ -842,6 +940,7 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         warm_up_rounds=config.warm_up_rounds,
         trace_prune_cap=config.trace_prune_cap,
         **_impair_params(config),
+        **_pull_params(config),
     )
     reg = get_registry()
     _enable_compilation_cache(config)
@@ -1042,6 +1141,7 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         warm_up_rounds=config.warm_up_rounds,
         trace_prune_cap=config.trace_prune_cap,
         **_impair_params(config),
+        **_pull_params(config),
     )
     with reg.span("engine/tables"):
         tables = make_cluster_tables(index.stakes.astype(np.int64))
@@ -1055,11 +1155,19 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         log.warning("WARNING: --mesh-devices %s > %s visible device(s); "
                     "clamping", mesh_dev, n_dev)
         mesh_dev = n_dev
+    node_shards = max(1, config.mesh_node_shards)
+    if node_shards > 1 and (mesh_dev < node_shards
+                            or mesh_dev % node_shards != 0):
+        log.warning("WARNING: --mesh-node-shards %s does not divide the "
+                    "%s-device mesh; falling back to origin-axis sharding "
+                    "only", node_shards, mesh_dev)
+        node_shards = 1
     if mesh_dev > 1:
         from .parallel import make_mesh
-        mesh = make_mesh(mesh_dev, node_shards=1)
-        log.info("all-origins: sharding origin batches over %s devices",
-                 mesh_dev)
+        mesh = make_mesh(mesh_dev, node_shards=node_shards)
+        log.info("all-origins: sharding origin batches over %s devices "
+                 "(%s origin-shard(s) x %s node-shard(s))",
+                 mesh_dev, mesh_dev // node_shards, node_shards)
 
     all_origins = (np.arange(N, dtype=np.int32) if origin_indices is None
                    else np.asarray(origin_indices, dtype=np.int32))
@@ -1068,9 +1176,12 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
     if total_o > 0:
         batch = min(batch, total_o)
     if mesh is not None:
-        batch = max(mesh_dev, batch // mesh_dev * mesh_dev)
+        o_shards = mesh_dev // node_shards
+        batch = max(o_shards, batch // o_shards * o_shards)
     reg.set_info("origin_batch", batch)
-    reg.set_info("mesh_shape", [mesh_dev] if mesh is not None else [1])
+    reg.set_info("mesh_shape",
+                 [mesh_dev // node_shards, node_shards]
+                 if mesh is not None else [1])
     single_batch = total_o <= batch
 
     agg = AllOriginsStats(index, params.hist_bins)
@@ -1100,7 +1211,7 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         if mesh is not None:
             from .parallel import shard_sim
             state, origins = shard_sim(mesh, state, origins,
-                                       shard_nodes=False)
+                                       shard_nodes=node_shards > 1)
         # Span conventions (obs/report.py): the first batch's call carries
         # the compile (host-blocking at dispatch) and records under
         # engine/compile; later batches dispatch asynchronously and their
@@ -1165,7 +1276,8 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
                 f: getattr(state_np, f)[:n_valid] for f in state_np._fields})
             agg.add_batch(rows, state_np, config.warm_up_rounds,
                           heal_at=config.heal_at,
-                          impaired=config.impairments_on)
+                          impaired=config.impairments_on,
+                          pull=config.has_pull)
         _push_sim_perf_point(dp_queue, 0, start_ts, blk_wall,
                              config.gossip_iterations, n_valid)
         log.info("all-origins: %s/%s origins done",
@@ -1205,7 +1317,9 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
             "coverage_mean": 0.0, "rmr_mean": 0.0, "elapsed_s": dt,
             "origin_iters_per_sec": total_o * config.gossip_iterations / dt,
             "mesh_devices": mesh_dev if mesh is not None else 1,
+            "mesh_node_shards": node_shards if mesh is not None else 1,
             "padded_sims": int(reg.counter("padded_sims") - padded_before),
+            "hop_clamped": 0,
             "stats": agg,
         }
     agg.finalize(config)
@@ -1226,9 +1340,24 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         "elapsed_s": dt,
         "origin_iters_per_sec": total_o * config.gossip_iterations / dt,
         "mesh_devices": mesh_dev if mesh is not None else 1,
+        "mesh_node_shards": node_shards if mesh is not None else 1,
         "padded_sims": int(reg.counter("padded_sims") - padded_before),
+        # LDH/hop-histogram clamp guard (VERDICT r5 #7): measured hop
+        # samples clamped into the top on-device bin — 0 means the
+        # aggregate hop/LDH stats are exact, nonzero already warned above
+        "hop_clamped": int(agg.hop_clamped),
         "stats": agg,
     }
+    if config.has_pull:
+        summary.update({
+            "pull_requests": int(agg.total_pull_requests),
+            "pull_responses": int(agg.total_pull_responses),
+            "pull_misses": int(agg.total_pull_requests
+                               - agg.total_pull_responses),
+            "pull_dropped": int(agg.total_pull_dropped),
+            "pull_suppressed": int(agg.total_pull_suppressed),
+            "pull_rescued": int(agg.total_pull_rescued),
+        })
     log.info("ALL-ORIGINS SUMMARY: %s",
              {k: v for k, v in summary.items() if k != "stats"})
     return summary
@@ -1295,6 +1424,14 @@ def _push_iteration_points(config, dp_queue, sim_iter, start_ts, stats,
             int(stats.dropped_stats.collection[-1]),
             int(stats.suppressed_stats.collection[-1]),
             stats.failed_count_series[-1])
+    if stats.has_pull_stats():
+        dp.create_sim_pull_point(
+            int(stats.pull_requests_stats.collection[-1]),
+            int(stats.pull_responses_stats.collection[-1]),
+            int(stats.pull_misses_stats.collection[-1]),
+            int(stats.pull_dropped_stats.collection[-1]),
+            int(stats.pull_suppressed_stats.collection[-1]),
+            int(stats.pull_rescued_stats.collection[-1]))
     dp.create_iteration_point(steady, sim_iter)
     dp_queue.push_back(dp)
 
@@ -1472,6 +1609,24 @@ def _collection_summaries(collection):
                                      for s in delivery
                                      if s.failed_count_series), default=0)),
         }
+    pulls = [s for s in sims if s.has_pull_stats()]
+    if pulls:
+        # run-report pull section rides in the free-form stats dict
+        # (obs/report.py schema unchanged)
+        stats["pull"] = {
+            "requests": int(sum(sum(s.pull_requests_stats.collection)
+                                for s in pulls)),
+            "responses": int(sum(sum(s.pull_responses_stats.collection)
+                                 for s in pulls)),
+            "misses": int(sum(sum(s.pull_misses_stats.collection)
+                              for s in pulls)),
+            "dropped": int(sum(sum(s.pull_dropped_stats.collection)
+                               for s in pulls)),
+            "suppressed": int(sum(sum(s.pull_suppressed_stats.collection)
+                                  for s in pulls)),
+            "rescued": int(sum(sum(s.pull_rescued_stats.collection)
+                               for s in pulls)),
+        }
     return stats, faults
 
 
@@ -1551,6 +1706,13 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
                     + i * config.step_size.as_float(), 1.0)
             c = config.stepped(churn_fail_rate=v)
             start = float(config.churn_fail_rate)
+        elif tt == Testing.PULL_FANOUT:
+            # pull_fanout is a traced EngineKnobs field: steps within the
+            # static pull_slots width (auto: 8) reuse one compiled
+            # executable (PR 4 invariant, tests/test_pull.py)
+            v = config.pull_fanout + i * config.step_size.as_int()
+            c = config.stepped(pull_fanout=v)
+            start = float(config.pull_fanout)
         else:  # NO_TEST
             c, start = config, 0.0
         if config.trace_dir and config.num_simulations > 1:
@@ -1595,6 +1757,12 @@ def main(argv=None) -> int:
         log.error("ERROR: multiple origin_ranks passed in but test type is "
                   "not OriginRank. This would end up running all simulations "
                   "with origin_rank[0]: %s", origin_ranks[0])
+        return 1
+
+    if config.test_type == Testing.PULL_FANOUT and not config.has_pull:
+        log.error("ERROR: --test-type pull-fanout requires a pull-capable "
+                  "--gossip-mode (pull or push-pull); mode is push, so "
+                  "every sweep point would be identical")
         return 1
 
     if config.gossip_iterations <= config.warm_up_rounds:
@@ -1643,7 +1811,19 @@ def main(argv=None) -> int:
             "end_to_end_origin_iters_per_sec":
                 summary["origin_iters_per_sec"],
             "end_to_end_elapsed_s": summary["elapsed_s"],
+            "hop_clamped": summary.get("hop_clamped", 0),
         }
+        if config.has_pull:
+            # same key set as the single-origin/sweep path's stats.pull
+            # (README run-report field table)
+            stats["pull"] = {
+                "requests": summary.get("pull_requests", 0),
+                "responses": summary.get("pull_responses", 0),
+                "misses": summary.get("pull_misses", 0),
+                "dropped": summary.get("pull_dropped", 0),
+                "suppressed": summary.get("pull_suppressed", 0),
+                "rescued": summary.get("pull_rescued", 0),
+            }
         faults = None
         agg = summary.get("stats")
         if config.impairments_on and agg is not None:

@@ -21,6 +21,7 @@ class Testing(enum.Enum):
     ROTATE_PROBABILITY = "rotate-probability"
     PACKET_LOSS = "packet-loss"
     CHURN = "churn"
+    PULL_FANOUT = "pull-fanout"
     NO_TEST = "no-test"
 
     def __str__(self):
@@ -35,6 +36,7 @@ class Testing(enum.Enum):
             Testing.ROTATE_PROBABILITY: "RotateProbability",
             Testing.PACKET_LOSS: "PacketLoss",
             Testing.CHURN: "Churn",
+            Testing.PULL_FANOUT: "PullFanout",
             Testing.NO_TEST: "NoTest",
         }[self]
 
@@ -105,6 +107,15 @@ class Config:
     partition_at: int = -1          # iteration the stake bipartition starts
     heal_at: int = -1               # iteration it heals (-1 = never)
 
+    # Pull-gossip / anti-entropy (pull.py; both backends, bit-equivalent
+    # decisions under the shared seed).  gossip_mode "push" keeps every
+    # output bit-identical to the push-only simulator:
+    gossip_mode: str = "push"       # "push" | "pull" | "push-pull"
+    pull_fanout: int = 2            # pull requests per live node per round
+    pull_interval: int = 1          # rounds between pull exchanges
+    pull_bloom_fp_rate: float = 0.1  # bloom false-positive probability
+    pull_request_cap: int = 0       # requests served per peer (<=0 = no cap)
+
     # TPU-framework extensions (not in the reference):
     backend: str = "tpu"            # "tpu" | "oracle"
     seed: int = 42                  # deterministic by construction
@@ -114,6 +125,10 @@ class Config:
     checkpoint_path: str = ""       # save sim state (periodically + at end)
     resume_path: str = ""           # load sim state and continue
     mesh_devices: int = 0           # 0 = all available devices
+    mesh_node_shards: int = 1       # shard the per-origin node axis over
+                                    # this many devices per origin-shard
+                                    # (parallel/mesh.py; must divide
+                                    # mesh_devices)
     jax_profile_dir: str = ""       # capture jax.profiler trace of measured
                                     # rounds (tpu backend); XProf shows the
                                     # round/* named_scope stages (obs/)
@@ -154,3 +169,10 @@ class Config:
         degradation trend has an anchor."""
         return (self.impairments_on
                 or self.test_type in (Testing.PACKET_LOSS, Testing.CHURN))
+
+    @property
+    def has_pull(self) -> bool:
+        """The gossip mode includes the pull (anti-entropy) phase — and
+        with it the pull counters/series (a PULL_FANOUT sweep requires a
+        pull mode; the CLI rejects it otherwise)."""
+        return self.gossip_mode != "push"

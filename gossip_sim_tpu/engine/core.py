@@ -76,6 +76,10 @@ from ..identity import stake_buckets_array
 from ..obs.spans import get_registry
 from ..obs.trace import (TRACE_CANDIDATE, TRACE_DROPPED, TRACE_FAILED_TARGET,
                          TRACE_SUPPRESSED)
+from ..pull import (PULL_DROPPED, PULL_MISS_ALREADY_HELD, PULL_MISS_BLOOM_FP,
+                    PULL_MISS_CAPPED, PULL_MISS_NOT_HELD, PULL_PEER_FAILED,
+                    PULL_RESPONSE, PULL_SUPPRESSED, SALT_PULL_BLOOM,
+                    SALT_PULL_CLASS, SALT_PULL_LOSS, SALT_PULL_MEMBER)
 from .params import EngineKnobs, EngineParams, EngineStatic
 from .sampler import SamplerTables, build_sampler_tables
 
@@ -127,7 +131,12 @@ class SimState(NamedTuple):
     ingress_acc: jax.Array  # [O, N] i32 measured-round ingress message counts
     prune_acc: jax.Array    # [O, N] i32 measured-round prune messages sent
     stranded_acc: jax.Array  # [O, N] i32 measured rounds each node was stranded
-    hops_hist_acc: jax.Array  # [O, H] i32 aggregate hop histogram (measured)
+    hops_hist_acc: jax.Array  # [O, H] i32 aggregate hop histogram (measured;
+                              # includes pull-sourced hops under pull modes)
+    pull_hops_hist_acc: jax.Array  # [O, H] i32 pull-sourced hop histogram
+                                   # (the pull-tagged slice of hops_hist_acc)
+    pull_rescued_acc: jax.Array    # [O, N] i32 measured rounds each node was
+                                   # rescued by a pull response (pull.py)
 
 
 def make_cluster_tables(stakes_lamports: np.ndarray) -> ClusterTables:
@@ -322,6 +331,8 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
         prune_acc=zi((O, N)),
         stranded_acc=zi((O, N)),
         hops_hist_acc=zi((O, H)),
+        pull_hops_hist_acc=zi((O, H)),
+        pull_rescued_acc=zi((O, N)),
     )
 
 
@@ -461,6 +472,12 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
         # get_nodes filter: bloom-contains(origin) == pruned bit OR peer == origin
         # (self-seeded bloom, push_active_set.rs:128-141,179).
         valid = is_peer & (~state.pruned) & (peer != origin_col)
+        if not p.has_push:
+            # pull-only mode (pull.py): the push phase emits nothing — the
+            # value spreads through pull responses alone.  The push
+            # machinery still runs on the resulting empty edge set so state
+            # layout, rotation and the row schema stay mode-invariant.
+            valid = jnp.zeros_like(valid)
         # first F valid slots, failed targets consume a slot but receive nothing
         # (gossip.rs:538-541): compact (slot-order) then mask failed targets.
         skey = jnp.where(valid, jnp.arange(S, dtype=jnp.int32)[None, None, :], S)
@@ -848,11 +865,184 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
                               jnp.where(full_row[..., None], shift_tf, append_tf),
                               tfail)
 
+    pull_got = None
+    if p.has_pull:
+        with jax.named_scope("round/pull"):
+            # ---- pull phase (pull.py): one request/response anti-entropy
+            # exchange against this round's push outcome.  Every decision is
+            # a stateless counter hash of (impair_seed, it, node ids), so the
+            # CPU oracle's PullOracle makes bit-identical choices; the stake
+            # weighting reuses the sampler's top-entry class CDF (weights
+            # (bucket+1)^2) with hash-derived uniforms instead of PRNG draws.
+            PS = p.pull_slots
+            NPS = N * PS
+            pull_on = (it % kn.pull_interval) == 0
+
+            # peer draws are origin-independent: one [N, PS] table per round
+            nodes_u = jnp.arange(N, dtype=jnp.uint32)[:, None]
+            slots_u = jnp.arange(PS, dtype=jnp.uint32)[None, :]
+            b_cls = round_basis_arr(kn.impair_seed, it, SALT_PULL_CLASS, jnp)
+            b_mem = round_basis_arr(kn.impair_seed, it, SALT_PULL_MEMBER, jnp)
+            u01 = lambda h: ((h >> jnp.uint32(8)).astype(jnp.float32)
+                             * jnp.float32(2.0 ** -24))
+            u_cls = u01(edge_u32_arr(b_cls, nodes_u, slots_u, jnp))  # [N, PS]
+            u_mem = u01(edge_u32_arr(b_mem, nodes_u, slots_u, jnp))
+            smp = tables.sampler
+            cdf_top = smp.class_cdf[-1]                              # [NB] f32
+            cls = jnp.sum((u_cls[..., None] >= cdf_top[:-1][None, None, :])
+                          .astype(jnp.int32), axis=-1)               # [N, PS]
+            ohf = (cls[..., None] == jnp.arange(
+                cdf_top.shape[0])[None, None, :]).astype(jnp.float32)
+            cstart = jnp.einsum("...c,c->...", ohf, smp.class_start.astype(
+                jnp.float32)).astype(jnp.int32)
+            ccount = jnp.einsum("...c,c->...", ohf, smp.class_count.astype(
+                jnp.float32)).astype(jnp.int32)
+            mpos = cstart + jnp.floor(
+                u_mem * ccount.astype(jnp.float32)).astype(jnp.int32)
+            mpos = jnp.minimum(mpos, cstart + jnp.maximum(ccount - 1, 0))
+            peer_ns = _lookup(smp.perm[None, :], mpos.reshape(1, NPS), N,
+                              pack).reshape(N, PS)                   # [N, PS]
+
+            # per-slot precedence (mirrors the push phase's failed target >
+            # partition > loss): dead requester / self-draw > failed peer >
+            # partition suppression > request loss > arrival
+            self_col = jnp.arange(N, dtype=jnp.int32)[:, None]
+            slot_live = (jnp.arange(PS, dtype=jnp.int32)[None, :]
+                         < kn.pull_fanout) & pull_on                 # [1, PS]
+            base_ns = (peer_ns != self_col) & slot_live              # [N, PS]
+            sent = base_ns[None, :, :] & (~failed)[:, :, None]       # [O,N,PS]
+            peer_o = jnp.broadcast_to(peer_ns[None], (O, N, PS))
+            tf_pull = _lookup(failed.astype(jnp.int32),
+                              peer_o.reshape(O, NPS), N,
+                              pack).reshape(O, N, PS) == 1
+            req_peer_failed = sent & tf_pull
+            livep = sent & ~tf_pull
+            pull_sup = pull_drop = None
+            if p.has_partition:
+                part_on_p = ((kn.partition_at >= 0) & (it >= kn.partition_at)
+                             & ((kn.heal_at < 0) | (it < kn.heal_at)))
+                side_dst_p = tables.side[peer_ns]                    # [N, PS]
+                pull_sup = (livep & part_on_p
+                            & (tables.side[:N][None, :, None]
+                               != side_dst_p[None]))
+                livep = livep & ~pull_sup
+            if p.has_loss:
+                b_loss = round_basis_arr(kn.impair_seed, it, SALT_PULL_LOSS,
+                                         jnp)
+                ue_p = edge_u32_arr(b_loss, nodes_u,
+                                    peer_ns.astype(jnp.uint32), jnp)
+                pull_drop = livep & (
+                    ue_p.astype(jnp.uint64)
+                    < rate_threshold_arr(kn.packet_loss_rate, jnp))[None]
+                livep = livep & ~pull_drop
+            arrived = livep                                          # [O,N,PS]
+
+            # per-peer arrival ranking (for the request cap) + arrived
+            # counts via the pseudo-entry sort: requests keyed by (peer,
+            # flat (requester, slot) order), one pseudo per peer sorting
+            # last in its run — the pseudo's rank is the peer's arrived
+            # count, a request's rank its service position.
+            arr_flat = arrived.reshape(O, NPS)
+            peer_flat = peer_o.reshape(O, NPS)
+            order = jnp.broadcast_to(
+                jnp.arange(NPS, dtype=jnp.int32)[None, :], (O, NPS))
+            kd_p = jnp.where(arr_flat, peer_flat, N)
+            kd_pc = jnp.concatenate([kd_p, pseudo_t], axis=1)
+            kv_pc = jnp.concatenate([order, jnp.full((O, N), BIG)], axis=1)
+            sk_p, skv_p = lax.sort((kd_pc, kv_pc), dimension=-1, num_keys=2)
+            rank_p = _rank_in_run(sk_p)
+            cnt_k = jnp.where((skv_p == BIG) & (sk_p < N), sk_p, BIG)
+            _, req_cnt_s = lax.sort((cnt_k, rank_p), dimension=-1, num_keys=1)
+            req_in = req_cnt_s[:, :N]                                # [O, N]
+            # route ranks back by flat (requester, slot) position: skv_p is
+            # that position for request entries and BIG for pseudos
+            _, rank_back = lax.sort((skv_p, rank_p), dimension=-1,
+                                    num_keys=1)
+            req_rank = rank_back[:, :NPS].reshape(O, N, PS)
+            served = arrived & ((kn.pull_request_cap <= 0)
+                                | (req_rank < kn.pull_request_cap))
+            capped = arrived & ~served
+
+            # response decision: peer holds (push-reached this round, the
+            # origin included), requester lacks, and the requester's bloom
+            # digest did not false-positive the value away
+            holds = _lookup(reached.astype(jnp.int32),
+                            peer_o.reshape(O, NPS), N,
+                            pack).reshape(O, N, PS) == 1
+            dist_safe = jnp.where(reached, dist, 0)
+            d_peer = _lookup(dist_safe, peer_o.reshape(O, NPS), N,
+                             pack).reshape(O, N, PS)
+            lacks = (~reached)[:, :, None]
+            b_fp = round_basis_arr(kn.impair_seed, it, SALT_PULL_BLOOM, jnp)
+            fp = (node_u32_arr(b_fp, jnp.arange(N, dtype=jnp.uint32), jnp)
+                  .astype(jnp.uint64)
+                  < rate_threshold_arr(kn.pull_bloom_fp_rate, jnp))  # [N]
+            transfer = served & holds & lacks & ~fp[None, :, None]
+            miss = arrived & ~transfer
+
+            # responses per peer (responder egress) via the same pseudo sort
+            tr_flat = transfer.reshape(O, NPS)
+            kd2 = jnp.where(tr_flat, peer_flat, N)
+            kd2c = jnp.concatenate([kd2, pseudo_t], axis=1)
+            kv2c = jnp.concatenate([jnp.zeros((O, NPS), jnp.int32),
+                                    jnp.full((O, N), BIG)], axis=1)
+            sk2, skv2 = lax.sort((kd2c, kv2c), dimension=-1, num_keys=2)
+            rank2 = _rank_in_run(sk2)
+            ck2 = jnp.where((skv2 == BIG) & (sk2 < N), sk2, BIG)
+            _, resp_cnt_s = lax.sort((ck2, rank2), dimension=-1, num_keys=1)
+            resp_out = resp_cnt_s[:, :N]                             # [O, N]
+
+            # delivery: best (minimum) responding hop + 1 per requester
+            hop_cand = jnp.where(transfer, d_peer + 1, INF)
+            pull_hop = jnp.min(hop_cand, axis=-1)                    # [O, N]
+            pull_got = pull_hop < INF
+
+            pull_egress = jnp.sum(arrived, -1, dtype=jnp.int32) + resp_out
+            pull_ingress = req_in + jnp.sum(transfer, -1, dtype=jnp.int32)
+            zero_o = jnp.zeros((O,), jnp.int32)
+            pull_counts = {
+                "pull_requests": jnp.sum(arrived, (1, 2), dtype=jnp.int32),
+                "pull_responses": jnp.sum(transfer, (1, 2), dtype=jnp.int32),
+                "pull_misses": jnp.sum(miss, (1, 2), dtype=jnp.int32),
+                "pull_dropped": (jnp.sum(pull_drop, (1, 2), dtype=jnp.int32)
+                                 if pull_drop is not None else zero_o),
+                "pull_suppressed": (jnp.sum(pull_sup, (1, 2), dtype=jnp.int32)
+                                    if pull_sup is not None else zero_o),
+                "pull_rescued": jnp.sum(pull_got, -1, dtype=jnp.int32),
+            }
+            if trace:
+                pc = jnp.zeros((O, N, PS), jnp.int32)
+                pc = jnp.where(req_peer_failed, PULL_PEER_FAILED, pc)
+                if pull_sup is not None:
+                    pc = jnp.where(pull_sup, PULL_SUPPRESSED, pc)
+                if pull_drop is not None:
+                    pc = jnp.where(pull_drop, PULL_DROPPED, pc)
+                pc = jnp.where(capped, PULL_MISS_CAPPED, pc)
+                pc = jnp.where(served & ~holds, PULL_MISS_NOT_HELD, pc)
+                pc = jnp.where(served & holds & ~lacks,
+                               PULL_MISS_ALREADY_HELD, pc)
+                pc = jnp.where(served & holds & lacks & fp[None, :, None],
+                               PULL_MISS_BLOOM_FP, pc)
+                pc = jnp.where(transfer, PULL_RESPONSE, pc)
+                trace_pull_peers = jnp.where(sent, peer_o, -1)
+                trace_pull_code = pc
+
+    # combined delivery view: push BFS plus this round's pull rescues.
+    # With has_pull off these alias the push arrays and the compiled
+    # graph is the exact pre-pull engine (mode=push bit-identity).
+    if p.has_pull:
+        reached_all = reached | pull_got
+        dist_all = jnp.where(reached, dist,
+                             jnp.where(pull_got, pull_hop, INF))
+    else:
+        reached_all, dist_all = reached, dist
+
     with jax.named_scope("round/round_stats"):
         # ---- statistics (gossip_stats.rs; on-device reductions) -------------
         hr = jnp.sum(
-            (jnp.minimum(dist, H - 1)[:, :, None] == jnp.arange(H)[None, None, :])
-            & reached[:, :, None], axis=1, dtype=jnp.int32)          # [O, H]
+            (jnp.minimum(dist_all, H - 1)[:, :, None]
+             == jnp.arange(H)[None, None, :])
+            & reached_all[:, :, None], axis=1, dtype=jnp.int32)      # [O, H]
         pos_counts = hr.at[:, 0].set(0)          # HopsStat filters origin's 0 hops
         cnt = jnp.sum(pos_counts, axis=-1)
         hsum = jnp.sum(pos_counts * jnp.arange(H)[None, :], axis=-1)
@@ -862,14 +1052,20 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
         hi_i = cnt // 2
         val_of = lambda i: 1 + jnp.sum((csum <= i[:, None]).astype(jnp.int32), axis=-1)
         hop_median = jnp.where(cnt > 0, (val_of(lo_i) + val_of(hi_i)) / 2.0, 0.0)
-        pos_hops = jnp.where(reached & (dist > 0), dist, 0)
+        pos_hops = jnp.where(reached_all & (dist_all > 0), dist_all, 0)
         hop_max = jnp.max(pos_hops, axis=-1)
         hop_min = jnp.where(
             cnt > 0,
-            jnp.min(jnp.where(reached & (dist > 0), dist, INF), axis=-1), 0)
+            jnp.min(jnp.where(reached_all & (dist_all > 0), dist_all, INF),
+                    axis=-1), 0)
 
-        stranded = (~reached) & (~failed)
+        # stranded excludes pull-rescued nodes; coverage counts them.  The
+        # RMR rows (m/n/rmr/branching) keep their push semantics — pull
+        # messages have their own counters (pull.py docstring).
+        stranded = (~reached_all) & (~failed)
         stranded_cnt = jnp.sum(stranded, axis=-1, dtype=jnp.int32)
+        n_reached_all = (jnp.sum(reached_all, axis=-1, dtype=jnp.int32)
+                         if p.has_pull else n_reached)
         m_total = m_push + m_prunes
         nn = n_reached
         rmr = jnp.where(nn > 1, m_total / jnp.maximum(nn - 1, 1) - 1.0, 0.0)
@@ -877,6 +1073,23 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
 
         measured = it >= kn.warm_up_rounds
         g = measured.astype(jnp.int32)
+        if p.has_pull:
+            # pull message counts flow into the same ingress/egress stats
+            # as push deliveries; the pull-tagged accumulators keep the
+            # pull-sourced slice separable (hop histogram + rescue counts)
+            egress_round_all = deg_out + pull_egress
+            ingress_round_all = ingress_round + pull_ingress
+            hr_pull = jnp.sum(
+                (jnp.minimum(pull_hop, H - 1)[:, :, None]
+                 == jnp.arange(H)[None, None, :])
+                & pull_got[:, :, None], axis=1, dtype=jnp.int32)
+            new_pull_hist = state.pull_hops_hist_acc + g * hr_pull
+            new_pull_rescued = (state.pull_rescued_acc
+                                + g * pull_got.astype(jnp.int32))
+        else:
+            egress_round_all, ingress_round_all = deg_out, ingress_round
+            new_pull_hist = state.pull_hops_hist_acc
+            new_pull_rescued = state.pull_rescued_acc
         new_state = SimState(
             key=state.key,
             active=new_active,
@@ -888,15 +1101,17 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             rc_slo=rc_slo,
             rc_upserts=rc_ups,
             failed=failed,
-            egress_acc=state.egress_acc + g * deg_out,
-            ingress_acc=state.ingress_acc + g * ingress_round,
+            egress_acc=state.egress_acc + g * egress_round_all,
+            ingress_acc=state.ingress_acc + g * ingress_round_all,
             prune_acc=state.prune_acc + g * n_pruned,
             stranded_acc=state.stranded_acc + g * stranded.astype(jnp.int32),
             hops_hist_acc=state.hops_hist_acc + g * hr,
+            pull_hops_hist_acc=new_pull_hist,
+            pull_rescued_acc=new_pull_rescued,
         )
         rows = {
-            "coverage": (n_reached / N).astype(jnp.float32),
-            "unvisited": (N - n_reached).astype(jnp.int32),
+            "coverage": (n_reached_all / N).astype(jnp.float32),
+            "unvisited": (N - n_reached_all).astype(jnp.int32),
             "m": m_total,
             "n": nn,
             "rmr": rmr.astype(jnp.float32),
@@ -920,13 +1135,22 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             # the last bin (dist > H - 1) and was clamped into it by the
             # min(dist, H - 1) binning above; dist == H - 1 is that bin's
             # legitimate value and does not count
-            "hop_clamped": jnp.sum(reached & (dist >= H), axis=-1,
+            "hop_clamped": jnp.sum(reached_all & (dist_all >= H), axis=-1,
                                    dtype=jnp.int32),
         }
+        if p.has_pull:
+            # pull-phase counters (pull.py accounting; all per-origin [O])
+            rows.update(pull_counts)
         if detail or trace:
             rows["stranded_mask"] = stranded
             rows["dist"] = jnp.where(reached, dist, -1).astype(jnp.int32)
             rows["failed_mask"] = failed
+            if p.has_pull:
+                # pull-sourced delivery hop per node (-1 = not pull-rescued);
+                # rows["dist"] stays the push-phase distance so the two
+                # delivery paths remain separable downstream
+                rows["pull_hop"] = jnp.where(pull_got, pull_hop,
+                                             -1).astype(jnp.int32)
         if edge_detail:
             # per-edge hop matrix: the engine equivalent of the reference's
             # ``orders`` debug dump (gossip.rs:374-390) — edge (src -> tgt)
@@ -948,6 +1172,11 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             rows["trace_rot"] = jnp.where(do_rot, chosen, -1)
             rows["trace_active"] = jnp.where(peer < N, peer, -1)
             rows["trace_pruned"] = state.pruned
+            if p.has_pull:
+                # flight recorder v2: pull request slots (sampled peer +
+                # PULL_* outcome code per slot, pull.py)
+                rows["trace_pull_peers"] = trace_pull_peers
+                rows["trace_pull_code"] = trace_pull_code
     return new_state, rows
 
 

@@ -54,6 +54,19 @@ def _resolve_k_inbound(inbound_cap: int, push_fanout: int) -> int:
     return max(16, 2 * push_fanout)
 
 
+def _resolve_pull_slots(pull_slots: int, pull_fanout: int) -> int:
+    """Physical pull-request slots per node (0 = auto: max(8, fanout)).
+
+    The slot count is the *static* array width; the traced ``pull_fanout``
+    knob masks slots beyond itself, so a PULL_FANOUT sweep within the
+    resolved width reuses one compiled executable (sweeping past it flips
+    the static shape and recompiles once — same contract as push_fanout
+    vs k_inbound)."""
+    if pull_slots > 0:
+        return pull_slots
+    return max(8, pull_fanout)
+
+
 class EngineKnobs(NamedTuple):
     """Dynamic numeric knobs, traced into the compiled round.
 
@@ -75,6 +88,13 @@ class EngineKnobs(NamedTuple):
     partition_at: np.int32                # bipartition window start
     heal_at: np.int32                     # bipartition window end (-1 never)
     impair_seed: np.uint32                # counter-hash seed (faults.py)
+    # pull-gossip knobs (pull.py); the pull phase itself is gated on the
+    # static ``gossip_mode`` — these only shape it, so a PULL sweep
+    # (fanout/interval/bloom-fp/cap) reuses one compiled executable
+    pull_fanout: np.int32                 # pull requests per node per round
+    pull_interval: np.int32               # rounds between pull exchanges
+    pull_bloom_fp_rate: np.float64        # bloom false-positive probability
+    pull_request_cap: np.int32            # served requests per peer (<=0 off)
 
 
 class EngineStatic(NamedTuple):
@@ -103,6 +123,13 @@ class EngineStatic(NamedTuple):
     has_loss: bool = False
     has_churn: bool = False
     has_partition: bool = False
+    # Gossip mode selects which protocol phases exist in the compiled graph
+    # (pull.py): "push" is the reference graph (bit-identical to the
+    # pre-pull engine), "pull" disables the push phase, "push-pull" runs
+    # both.  ``pull_slots`` is the RESOLVED static pull-request width (0
+    # when the mode has no pull phase).
+    gossip_mode: str = "push"
+    pull_slots: int = 0
 
     @property
     def num_buckets(self) -> int:
@@ -111,6 +138,14 @@ class EngineStatic(NamedTuple):
     @property
     def has_impairments(self) -> bool:
         return self.has_loss or self.has_churn or self.has_partition
+
+    @property
+    def has_pull(self) -> bool:
+        return self.gossip_mode != "push"
+
+    @property
+    def has_push(self) -> bool:
+        return self.gossip_mode != "pull"
 
     @property
     def prune_cap(self) -> int:
@@ -151,6 +186,24 @@ class EngineParams(NamedTuple):
     heal_at: int = -1                # iteration it heals (-1 = never)
     impair_seed: int = 0             # hash seed for all impairment streams
 
+    # Pull-gossip (anti-entropy) knobs (pull.py).  ``gossip_mode`` is the
+    # static phase selector: "push" (default) compiles the exact reference
+    # graph, "pull" disables the push phase, "push-pull" runs both.  The
+    # numeric knobs are traced (EngineKnobs), so sweeping any of them
+    # reuses one compiled executable; every pull decision is a stateless
+    # counter hash of (impair_seed, iteration, node ids) shared bit-exactly
+    # with the oracle's PullOracle.
+    gossip_mode: str = "push"
+    pull_fanout: int = 2             # pull requests per live node per round
+    pull_interval: int = 1           # rounds between pull exchanges
+    pull_bloom_fp_rate: float = 0.1  # bloom FP probability (Solana's 0.1)
+    pull_request_cap: int = 0        # requests served per peer per round
+                                     # (<= 0 = unlimited)
+    pull_slots: int = 0              # physical pull-request slots per node
+                                     # (static shape; 0 = auto:
+                                     # max(8, pull_fanout) so fanout sweeps
+                                     # within 8 compile once)
+
     # Dense-shape knobs (TPU formulation only; see engine/core.py for the
     # documented divergences they introduce):
     rc_slots: int = 64      # physical received-cache slots per (origin, node)
@@ -188,6 +241,22 @@ class EngineParams(NamedTuple):
         return self.churn_fail_rate > 0.0 or self.churn_recover_rate > 0.0
 
     @property
+    def has_pull(self) -> bool:
+        """True when the gossip mode includes the pull (anti-entropy)
+        phase (pull.py)."""
+        return self.gossip_mode != "push"
+
+    @property
+    def has_push(self) -> bool:
+        return self.gossip_mode != "pull"
+
+    @property
+    def pull_slots_resolved(self) -> int:
+        """Resolved static pull-request width (``pull_slots``; 0 = auto:
+        max(8, pull_fanout))."""
+        return _resolve_pull_slots(self.pull_slots, self.pull_fanout)
+
+    @property
     def prune_cap(self) -> int:
         """Resolved flight-recorder prune-pair capture width per round
         (``trace_prune_cap``; 0 = auto: 16*num_nodes, never more than the
@@ -221,6 +290,8 @@ class EngineParams(NamedTuple):
             has_loss=self.packet_loss_rate > 0.0,
             has_churn=self.has_churn,
             has_partition=self.partition_at >= 0,
+            gossip_mode=self.gossip_mode,
+            pull_slots=self.pull_slots_resolved if self.has_pull else 0,
         )
 
     def knob_values(self) -> EngineKnobs:
@@ -238,6 +309,10 @@ class EngineParams(NamedTuple):
             partition_at=np.int32(self.partition_at),
             heal_at=np.int32(self.heal_at),
             impair_seed=np.uint32(self.impair_seed & 0xFFFFFFFF),
+            pull_fanout=np.int32(self.pull_fanout),
+            pull_interval=np.int32(max(1, self.pull_interval)),
+            pull_bloom_fp_rate=np.float64(self.pull_bloom_fp_rate),
+            pull_request_cap=np.int32(self.pull_request_cap),
         )
 
     def split(self) -> tuple[EngineStatic, EngineKnobs]:
@@ -261,4 +336,14 @@ class EngineParams(NamedTuple):
         if self.partition_at >= 0 and self.heal_at >= 0:
             assert self.heal_at >= self.partition_at, (
                 "heal_at must not precede partition_at")
+        assert self.gossip_mode in ("push", "pull", "push-pull"), (
+            f"unknown gossip_mode: {self.gossip_mode!r}")
+        if self.has_pull:
+            assert self.pull_fanout >= 1, "pull_fanout must be >= 1"
+            assert self.pull_interval >= 1, "pull_interval must be >= 1"
+            assert 0.0 <= self.pull_bloom_fp_rate <= 1.0, (
+                "pull_bloom_fp_rate must be in [0, 1]")
+            assert self.pull_fanout <= self.pull_slots_resolved, (
+                "pull_fanout exceeds the static pull_slots width — raise "
+                "EngineParams.pull_slots")
         return self

@@ -58,11 +58,18 @@ import tempfile
 
 import numpy as np
 
+from ..pull import PULL_CODE_NAMES
 from .report import RUN_REPORT_SCHEMA, config_dict
 
 log = logging.getLogger("gossip_sim_tpu.obs")
 
-TRACE_SCHEMA = "gossip-sim-tpu/trace/v1"
+# v2 (pull-gossip subsystem): adds the pull request/response event arrays
+# (``pull_peers``/``pull_code``/``pull_hop``) plus the ``gossip_mode`` /
+# ``pull_slots`` manifest keys.  New traces are written as v2 (pull arrays
+# present only when the mode has a pull phase); v1 traces remain readable.
+TRACE_SCHEMA_V1 = "gossip-sim-tpu/trace/v1"
+TRACE_SCHEMA = "gossip-sim-tpu/trace/v2"
+READABLE_SCHEMAS = (TRACE_SCHEMA_V1, TRACE_SCHEMA)
 MANIFEST_NAME = "manifest.json"
 
 # per-slot outcome codes (shared with engine/core.py round_step and the
@@ -97,6 +104,26 @@ ARRAY_SPECS = {
     "prunes_total": ("int32", ()),
 }
 
+#: v2 pull-phase arrays (pull.py), present when the manifest's
+#: ``gossip_mode`` includes a pull phase.  Dims: Q = pull_slots.
+PULL_ARRAY_SPECS = {
+    "pull_peers": ("int16", ("N", "Q")),
+    "pull_code": ("int8", ("N", "Q")),
+    "pull_hop": ("int16", ("N",)),
+}
+
+#: every array name any readable schema can carry
+ALL_ARRAY_SPECS = {**ARRAY_SPECS, **PULL_ARRAY_SPECS}
+
+
+def specs_for_manifest(manifest: dict) -> dict:
+    """The array-spec dict a manifest's schema/mode implies (v1 manifests
+    and v2 push-mode manifests carry the base arrays only)."""
+    return {name: ALL_ARRAY_SPECS[name]
+            for name in (manifest.get("arrays") or ARRAY_SPECS)
+            if name in ALL_ARRAY_SPECS}
+
+
 #: engine row name -> segment array name (detail + trace rows, cli harvest)
 _ENGINE_ROW_MAP = {
     "trace_peers": "peers",
@@ -113,13 +140,27 @@ _ENGINE_ROW_MAP = {
     "prunes_sent": "prunes_total",
 }
 
+#: engine trace rows -> v2 pull arrays (only emitted under pull modes)
+_ENGINE_PULL_ROW_MAP = {
+    "trace_pull_peers": "pull_peers",
+    "trace_pull_code": "pull_code",
+    "pull_hop": "pull_hop",
+}
+
 _MATCH_KEYS = ("schema", "backend", "num_nodes", "push_fanout",
-               "active_set_size", "prune_cap", "seed", "origins")
+               "active_set_size", "prune_cap", "seed", "origins",
+               "gossip_mode", "pull_slots")
 
 
 def block_from_engine_rows(rows) -> dict:
-    """Engine harvest rows (numpy, ``[R, O, ...]``) -> writer block dict."""
-    return {seg: np.asarray(rows[eng]) for eng, seg in _ENGINE_ROW_MAP.items()}
+    """Engine harvest rows (numpy, ``[R, O, ...]``) -> writer block dict.
+    Pull-phase rows ride along when the engine emitted them (pull modes)."""
+    block = {seg: np.asarray(rows[eng])
+             for eng, seg in _ENGINE_ROW_MAP.items()}
+    for eng, seg in _ENGINE_PULL_ROW_MAP.items():
+        if eng in rows:
+            block[seg] = np.asarray(rows[eng])
+    return block
 
 
 def _atomic_write_bytes(path: str, payload: bytes) -> None:
@@ -174,13 +215,17 @@ class TraceWriter:
     def __init__(self, trace_dir: str, *, backend: str, num_nodes: int,
                  push_fanout: int, active_set_size: int, prune_cap: int,
                  origins, origin_pubkeys, seed: int, warm_up_rounds: int,
-                 iterations: int, config=None):
+                 iterations: int, config=None, gossip_mode: str = "push",
+                 pull_slots: int = 0):
         if num_nodes > self.MAX_TRACE_NODES:
             raise ValueError(
                 f"trace arrays store node ids as int16; num_nodes must be "
                 f"<= {self.MAX_TRACE_NODES}, got {num_nodes}")
         self.trace_dir = trace_dir
         os.makedirs(trace_dir, exist_ok=True)
+        self.array_specs = dict(ARRAY_SPECS)
+        if gossip_mode != "push":
+            self.array_specs.update(PULL_ARRAY_SPECS)
         self.manifest = {
             "schema": TRACE_SCHEMA,
             "run_report_schema": RUN_REPORT_SCHEMA,
@@ -189,14 +234,17 @@ class TraceWriter:
             "push_fanout": int(push_fanout),
             "active_set_size": int(active_set_size),
             "prune_cap": int(prune_cap),
+            "gossip_mode": str(gossip_mode),
+            "pull_slots": int(pull_slots) if gossip_mode != "push" else 0,
             "origins": [int(o) for o in origins],
             "origin_pubkeys": [str(p) for p in origin_pubkeys],
             "seed": int(seed),
             "warm_up_rounds": int(warm_up_rounds),
             "iterations": int(iterations),
             "codes": {str(k): v for k, v in TRACE_CODE_NAMES.items()},
+            "pull_codes": {str(k): v for k, v in PULL_CODE_NAMES.items()},
             "arrays": {name: {"dtype": dt, "dims": list(dims)}
-                       for name, (dt, dims) in ARRAY_SPECS.items()},
+                       for name, (dt, dims) in self.array_specs.items()},
             "config": config_dict(config) if config is not None else {},
             "segments": [],
         }
@@ -239,7 +287,7 @@ class TraceWriter:
         """
         n_rounds = None
         out = {}
-        for name, (dtype, _) in ARRAY_SPECS.items():
+        for name, (dtype, _) in self.array_specs.items():
             if name not in block:
                 raise ValueError(f"trace block missing array: {name}")
             arr = np.asarray(block[name])
@@ -340,7 +388,8 @@ class OracleTraceCollector:
     """
 
     def __init__(self, index, origin_pubkey, *, push_fanout: int,
-                 active_set_size: int, prune_cap: int):
+                 active_set_size: int, prune_cap: int,
+                 gossip_mode: str = "push", pull_slots: int = 0):
         self.index = index
         self.origin_pk = origin_pubkey
         self.origin_idx = index.index_of(origin_pubkey)
@@ -348,6 +397,11 @@ class OracleTraceCollector:
         self.S = int(active_set_size)
         self.P = int(prune_cap)
         self.N = len(index)
+        self.gossip_mode = str(gossip_mode)
+        self.Q = int(pull_slots)
+        self.array_specs = dict(ARRAY_SPECS)
+        if self.gossip_mode != "push":
+            self.array_specs.update(PULL_ARRAY_SPECS)
         self._pre = None
         self._rounds = []     # [(round, {name: [O=1, ...] array})]
 
@@ -426,9 +480,24 @@ class OracleTraceCollector:
             "peers": peers, "code": code, "dist": dist, "first_src": first,
             "failed": failed, "rot": rot, "active": active, "pruned": pruned,
             "prune_src": prune_src, "prune_dst": prune_dst,
-            "coverage": np.float32(len(cluster.visited) / N),
+            "coverage": np.float32((len(cluster.visited)
+                                    + (len(cluster.pull.rescued)
+                                       if cluster.pull is not None else 0))
+                                   / N),
             "prunes_total": np.int32(total_prunes),
         }
+        if self.gossip_mode != "push":
+            # pull-phase capture (pull.py): the PullRound already carries
+            # the engine-shaped per-slot arrays
+            pr = cluster.pull
+            if pr is not None:
+                row["pull_peers"] = pr.peers
+                row["pull_code"] = pr.code
+                row["pull_hop"] = pr.pull_hop
+            else:
+                row["pull_peers"] = np.full((N, self.Q), -1, np.int16)
+                row["pull_code"] = np.zeros((N, self.Q), np.int8)
+                row["pull_hop"] = np.full(N, -1, np.int16)
         self._rounds.append((int(it), row))
 
     def flush(self):
@@ -439,7 +508,7 @@ class OracleTraceCollector:
         start = self._rounds[0][0]
         block = {
             name: np.stack([row[name] for _, row in self._rounds])[:, None]
-            for name in ARRAY_SPECS
+            for name in self.array_specs
         }
         self._rounds = []
         return start, block
@@ -503,13 +572,14 @@ def load_trace(trace_dir: str) -> Trace:
     segs = sorted(manifest["segments"], key=lambda g: g["start_round"])
     if not segs:
         raise ValueError(f"trace {trace_dir} has no segments")
-    rounds_parts, parts = [], {name: [] for name in ARRAY_SPECS}
+    specs = specs_for_manifest(manifest)
+    rounds_parts, parts = [], {name: [] for name in specs}
     gaps = []
     prev_end = None
     for seg in segs:
         with np.load(os.path.join(trace_dir, seg["file"])) as z:
             rounds_parts.append(z["rounds"])
-            for name in ARRAY_SPECS:
+            for name in specs:
                 parts[name].append(z[name])
         if prev_end is not None and seg["start_round"] != prev_end:
             gaps.append((prev_end, seg["start_round"]))
@@ -518,7 +588,7 @@ def load_trace(trace_dir: str) -> Trace:
         log.warning("WARNING: trace %s has round gap(s): %s", trace_dir,
                     gaps)
     rounds = np.concatenate(rounds_parts)
-    arrays = {name: np.concatenate(parts[name]) for name in ARRAY_SPECS}
+    arrays = {name: np.concatenate(parts[name]) for name in specs}
     return Trace(manifest, rounds, arrays, gaps=gaps)
 
 
@@ -527,7 +597,7 @@ def validate_trace_manifest(manifest: dict) -> list:
     problems = []
     if not isinstance(manifest, dict):
         return [f"manifest is {type(manifest).__name__}, not dict"]
-    if manifest.get("schema") != TRACE_SCHEMA:
+    if manifest.get("schema") not in READABLE_SCHEMAS:
         problems.append(f"unknown schema: {manifest.get('schema')!r}")
     for key, types in (("backend", str), ("num_nodes", int),
                        ("push_fanout", int), ("active_set_size", int),
@@ -541,6 +611,18 @@ def validate_trace_manifest(manifest: dict) -> list:
     for name in ARRAY_SPECS:
         if name not in (manifest.get("arrays") or {}):
             problems.append(f"arrays entry missing: {name}")
+    if manifest.get("schema") == TRACE_SCHEMA:
+        # v2: mode + pull geometry are mandatory; pull arrays exist exactly
+        # when the mode has a pull phase
+        mode = manifest.get("gossip_mode")
+        if mode not in ("push", "pull", "push-pull"):
+            problems.append(f"v2 manifest: bad gossip_mode {mode!r}")
+        if not isinstance(manifest.get("pull_slots"), int):
+            problems.append("v2 manifest: pull_slots missing or not int")
+        if mode in ("pull", "push-pull"):
+            for name in PULL_ARRAY_SPECS:
+                if name not in (manifest.get("arrays") or {}):
+                    problems.append(f"pull arrays entry missing: {name}")
     for seg in manifest.get("segments") or []:
         if (not isinstance(seg, dict) or "file" not in seg
                 or "start_round" not in seg or "end_round" not in seg):
@@ -574,7 +656,9 @@ def validate_trace_dir(trace_dir: str) -> list:
     n, f_, s, p = (manifest["num_nodes"], manifest["push_fanout"],
                    manifest["active_set_size"], manifest["prune_cap"])
     o = len(manifest["origins"])
-    dim = {"N": n, "F": f_, "S": s, "P": p}
+    dim = {"N": n, "F": f_, "S": s, "P": p,
+           "Q": manifest.get("pull_slots", 0)}
+    specs = specs_for_manifest(manifest)
     for seg in manifest["segments"]:
         fpath = os.path.join(trace_dir, seg["file"])
         if not os.path.exists(fpath):
@@ -583,7 +667,7 @@ def validate_trace_dir(trace_dir: str) -> list:
         r = seg["end_round"] - seg["start_round"]
         with np.load(fpath) as z:
             names = set(z.files)
-            for name, (dtype, dims) in ARRAY_SPECS.items():
+            for name, (dtype, dims) in specs.items():
                 if name not in names:
                     problems.append(f"{seg['file']}: missing array {name}")
                     continue

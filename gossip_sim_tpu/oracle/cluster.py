@@ -30,6 +30,8 @@ from __future__ import annotations
 import logging
 from collections import deque
 
+import numpy as np
+
 from ..constants import CRDS_UNIQUE_PUBKEY_CAPACITY, UNREACHED
 from ..obs.trace import (TRACE_CANDIDATE, TRACE_DROPPED, TRACE_FAILED_TARGET,
                          TRACE_SUPPRESSED)
@@ -91,6 +93,10 @@ class Cluster:
         # OracleTraceCollector.begin_round), run_gossip appends one
         # (src, dst, TRACE_* code) event per attempted fanout slot
         self.edge_log = None
+        # pull phase (pull.py): run_pull stores this round's PullRound here;
+        # coverage/stranded/hops observers fold the rescues in
+        self.pull = None
+        self.pull_index = None   # NodeIndex used to translate pull results
 
     def _clear(self, stakes):
         self.visited.clear()
@@ -104,6 +110,7 @@ class Cluster:
         self.egress_message_count.clear()
         self.ingress_message_count.clear()
         self.prune_messages_sent.clear()
+        self.pull = None
 
     # -- verb 1: push/diffuse ------------------------------------------------
 
@@ -161,6 +168,55 @@ class Cluster:
                     self.mst.setdefault(current, set()).add(neighbor)
                     self.rmr.increment_n()
                 self.orders.setdefault(neighbor, {})[current] = dist + 1
+
+    # -- pull phase (anti-entropy; pull.py) ----------------------------------
+
+    def run_pull(self, pull_oracle, it, index, node_map):
+        """One pull request/response exchange against this round's push
+        outcome (pull.PullOracle — the identical spec the engine's
+        ``round/pull`` block implements).  Pull deliveries join coverage /
+        hops / stranded accounting tagged pull-sourced; request/response
+        messages flow into the ingress/egress counters.  Must run after
+        ``run_gossip`` (it consumes this round's distances)."""
+        from ..constants import UNREACHED
+
+        n = len(index)
+        hops = np.full(n, -1, np.int64)
+        for pk, d in self.distances.items():
+            if d != UNREACHED:
+                hops[index.index_of(pk)] = d
+        failed = np.array([node_map[pk].failed for pk in index.pubkeys],
+                          dtype=bool)
+        self.pull = pull_oracle.run_round(it, hops, failed)
+        self.pull_index = index
+        for i in np.nonzero(self.pull.egress)[0]:
+            pk = index.pubkeys[int(i)]
+            self.egress_message_count[pk] = (
+                self.egress_message_count.get(pk, 0)
+                + int(self.pull.egress[i]))
+        for i in np.nonzero(self.pull.ingress)[0]:
+            pk = index.pubkeys[int(i)]
+            self.ingress_message_count[pk] = (
+                self.ingress_message_count.get(pk, 0)
+                + int(self.pull.ingress[i]))
+        return self.pull
+
+    def pull_rescued_pubkeys(self):
+        """{pubkey: pull hop} for this round's pull-rescued nodes."""
+        if self.pull is None or not self.pull.rescued:
+            return {}
+        pks = self.pull_index.pubkeys
+        return {pks[i]: hop for i, hop in self.pull.rescued.items()}
+
+    def hops_with_pull(self):
+        """``distances`` with pull-rescued nodes folded in at their pull
+        hop — the combined per-node hop view the stats layer records."""
+        rescued = self.pull_rescued_pubkeys()
+        if not rescued:
+            return self.distances
+        merged = dict(self.distances)
+        merged.update(rescued)
+        return merged
 
     # -- verb 2: consume -----------------------------------------------------
 
@@ -246,14 +302,19 @@ class Cluster:
     # -- observers -----------------------------------------------------------
 
     def coverage(self, stakes):
-        """(fraction visited, #unvisited) (gossip.rs:321-327)."""
-        return (len(self.visited) / len(stakes),
-                len(stakes) - len(self.visited))
+        """(fraction visited, #unvisited) (gossip.rs:321-327); pull-rescued
+        nodes (pull.py) count as visited."""
+        rescued = len(self.pull.rescued) if self.pull is not None else 0
+        return ((len(self.visited) + rescued) / len(stakes),
+                len(stakes) - len(self.visited) - rescued)
 
     def stranded_nodes(self):
-        """Unreached and not failed (gossip.rs:329-345)."""
+        """Unreached and not failed (gossip.rs:329-345); nodes rescued by a
+        pull response this round are not stranded."""
+        rescued = self.pull_rescued_pubkeys()
         return [pk for pk, d in self.distances.items()
-                if d == UNREACHED and pk not in self.failed_nodes]
+                if d == UNREACHED and pk not in self.failed_nodes
+                and pk not in rescued]
 
     def relative_message_redundancy(self):
         """Memoized RMR accessor (gossip.rs:435-443)."""

@@ -51,6 +51,10 @@ def state_shardings(mesh: Mesh, shard_nodes: bool = True) -> dict:
         "prune_acc": P("origins", n),
         "stranded_acc": P("origins", n),
         "hops_hist_acc": P("origins"),
+        # pull-gossip accumulators (pull.py): histogram rows replicate on
+        # the node axis like hops_hist_acc, rescue counts shard with it
+        "pull_hops_hist_acc": P("origins"),
+        "pull_rescued_acc": P("origins", n),
     }
 
 

@@ -1,0 +1,300 @@
+"""Pull-gossip (anti-entropy) primitives, shared by both backends.
+
+The reference simulates only the push protocol — "Pull gossip is explicitly
+not simulated" (reference README.md:271-272) — so its coverage/stranded
+numbers ignore the anti-entropy path real Solana gossip relies on to heal
+exactly the degraded regimes the fault-injection subsystem (faults.py)
+creates.  This module adds a deterministic pull phase modeled on Solana's
+CRDS pull (gossip/src/crds_gossip_pull.rs):
+
+* Each round where ``it % pull_interval == 0``, every **live** node
+  stake-weight-samples ``pull_fanout`` pull peers and sends each a pull
+  request carrying a bloom-filter digest of its known set.
+* A contacted peer that is live and **holds** the origin value this round
+  (it was reached by the push BFS; the origin itself always holds) responds
+  with the value — unless the requester's bloom digest claims the requester
+  already has it.  A requester that was reached by push genuinely has the
+  value in its bloom (no response needed); a requester that was NOT reached
+  suffers a bloom **false positive** with probability ``pull_bloom_fp_rate``
+  (the responder wrongly filters the value out — a missed rescue).
+* Pull deliveries get ``hop = holder_hop + 1`` and are tagged pull-sourced
+  in delivery/hop/stranded accounting; they do NOT enter the received-cache
+  / prune machinery (prunes are push-path-only in Solana too) and do not
+  change the push RMR rows.
+* ``pull_request_cap`` > 0 bounds how many arrived requests a peer serves
+  per round (Solana caps pull-response bandwidth); excess requests are
+  counted as capped misses.  Requests are served in (requester index, slot)
+  arrival order — deterministic and identical in both backends.
+
+Determinism contract (the faults.py philosophy): the two backends consume
+randomness in different orders, so every pull decision is a *stateless
+counter hash* of ``(impair_seed, iteration, node ids)``:
+
+    peer draw   u_class/u_member = u01(fmix32-edge-hash(seed, it, node, slot))
+    bloom FP    fmix32-node-hash(seed, it, node)      < fp_rate   * 2^32
+    request loss fmix32-edge-hash(seed, it, src, dst) < loss_rate * 2^32
+
+The stake weighting reuses the push machinery's stake-class factorization
+(engine/sampler.py): with 25 stake buckets the active-set weight profile
+``(min(bucket, k) + 1)^2`` at its top entry ``k = 24`` reduces to
+``(bucket + 1)^2`` — a 25-way class CDF plus a uniform within-class draw.
+Pull peer selection is origin-independent (a node's pull partner does not
+depend on which value it is missing), so one ``[N, pull_fanout]`` draw per
+round serves every origin-sim.  The class CDF is computed here in the same
+f64-cumsum -> f32 arithmetic as ``build_sampler_tables`` and the uniform
+mapping ``u01 = (h >> 8) * 2^-24`` is exactly representable in f32, so the
+scalar (oracle) and vectorized (engine) paths agree bit-for-bit.
+
+Per-slot precedence (mirroring the push phase's failed target > partition >
+loss): dead requester / self-draw > failed peer > partition suppression >
+request loss > arrival; an arrived request is then capped / not-held /
+already-held / bloom-FP / answered.
+
+Message accounting mirrors the push phase (only what arrives counts):
+an arrived request is 1 egress for the requester and 1 ingress for the
+peer; a response is 1 egress for the responder and 1 ingress for the
+requester.  Dropped/suppressed requests consume the slot and are counted
+(the ``sim_pull`` dropped/suppressed fields) but move no messages;
+requests into churn-failed peers likewise consume the slot and move
+nothing — they appear only as the ``peer_failed`` trace outcome, not in
+any counter (exactly like pushes to failed targets on the push path).
+
+Everything here is numpy-only: importing this module never touches JAX.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from .constants import NUM_PUSH_ACTIVE_SET_ENTRIES
+from .faults import (edge_u32, node_u32, partition_active, rate_threshold,
+                     round_basis, stake_bipartition)
+from .identity import stake_buckets_array
+
+NB = NUM_PUSH_ACTIVE_SET_ENTRIES  # 25
+
+# domain-separation salts for the pull hash streams (faults.py convention)
+SALT_PULL_CLASS = 0x1B873593    # peer draw: stake-class uniform
+SALT_PULL_MEMBER = 0xE6546B64   # peer draw: within-class uniform
+SALT_PULL_BLOOM = 0xCC9E2D51    # per-(round, requester) bloom-FP event
+SALT_PULL_LOSS = 0x38B34AE5     # per-(round, requester, peer) request loss
+
+# per-slot outcome codes (trace schema v2; obs/trace.py ``pull_code``)
+PULL_EMPTY = 0            # inactive slot / self-draw / dead requester
+PULL_RESPONSE = 1         # value transferred to the requester
+PULL_PEER_FAILED = 2      # request sent into a churn-failed peer
+PULL_SUPPRESSED = 3       # cross-partition request suppressed
+PULL_DROPPED = 4          # request lost to packet loss
+PULL_MISS_NOT_HELD = 5    # peer does not hold the value this round
+PULL_MISS_ALREADY_HELD = 6  # requester already holds it (bloom true match)
+PULL_MISS_BLOOM_FP = 7    # bloom false positive filtered the rescue out
+PULL_MISS_CAPPED = 8      # peer's pull_request_cap already exhausted
+PULL_CODE_NAMES = {
+    PULL_EMPTY: "empty",
+    PULL_RESPONSE: "response",
+    PULL_PEER_FAILED: "peer_failed",
+    PULL_SUPPRESSED: "suppressed",
+    PULL_DROPPED: "dropped",
+    PULL_MISS_NOT_HELD: "miss_not_held",
+    PULL_MISS_ALREADY_HELD: "miss_already_held",
+    PULL_MISS_BLOOM_FP: "miss_bloom_fp",
+    PULL_MISS_CAPPED: "miss_capped",
+}
+
+
+def u01_from_u32(h: int) -> np.float32:
+    """u32 hash -> f32 uniform in [0, 1): ``(h >> 8) * 2^-24``.
+
+    The 24 surviving bits fit the f32 mantissa exactly, so the value is
+    identical whether computed on Python ints (here) or uint32 lanes
+    (engine/core.py ``_pull_u01``)."""
+    return np.float32(h >> 8) * np.float32(2.0 ** -24)
+
+
+class PullTables(NamedTuple):
+    """Static stake-class sampling tables for the pull peer draw (numpy).
+
+    ``cdf`` is the top-entry (k = 24) class CDF — weights ``(bucket+1)^2``
+    — computed with the identical f64-cumsum -> f32 arithmetic as
+    ``engine/sampler.build_sampler_tables``, so ``cdf`` equals the engine's
+    ``sampler.class_cdf[-1]`` bit-for-bit (tests/test_pull.py locks this
+    down)."""
+
+    perm: np.ndarray         # [N] i32  node ids sorted by bucket (stable)
+    class_start: np.ndarray  # [NB] i32
+    class_count: np.ndarray  # [NB] i32
+    cdf: np.ndarray          # [NB] f32 inclusive CDF, cdf[-1] == 1.0
+
+
+def pull_class_tables(stakes) -> PullTables:
+    """Build the pull sampling tables from the per-node stake vector."""
+    buckets = stake_buckets_array(
+        np.asarray(stakes, dtype=np.int64).astype(np.uint64)).astype(np.int32)
+    class_count = np.bincount(buckets, minlength=NB).astype(np.int32)
+    class_start = np.concatenate(
+        [[0], np.cumsum(class_count)[:-1]]).astype(np.int32)
+    c = np.arange(NB)
+    mass = class_count.astype(np.float64) * ((c + 1) ** 2)
+    cdf = np.cumsum(mass)
+    total = cdf[-1] if cdf[-1] != 0 else 1.0
+    cdf = (cdf / total).astype(np.float32)
+    cdf[-1] = 1.0
+    return PullTables(
+        perm=np.argsort(buckets, kind="stable").astype(np.int32),
+        class_start=class_start,
+        class_count=class_count,
+        cdf=cdf,
+    )
+
+
+def sample_pull_peer(tables: PullTables, basis_cls: int, basis_mem: int,
+                     node: int, slot: int) -> int:
+    """One stake-weighted pull peer draw (scalar path; may return ``node``
+    itself — self-draws discard the slot).
+
+    Mirrors the engine's elementwise draw exactly: f32 class compare
+    against the shared CDF, f32 ``floor(u * count)`` within the class."""
+    u_cls = u01_from_u32(edge_u32(basis_cls, node, slot))
+    cls = int(np.count_nonzero(u_cls >= tables.cdf[:-1]))
+    start = int(tables.class_start[cls])
+    count = int(tables.class_count[cls])
+    u_mem = u01_from_u32(edge_u32(basis_mem, node, slot))
+    pos = start + int(np.floor(u_mem * np.float32(count)))
+    pos = min(pos, start + max(count - 1, 0))
+    return int(tables.perm[pos])
+
+
+class PullRound(NamedTuple):
+    """One round's pull-phase outcome (oracle side; the engine emits the
+    same quantities as ``rows["pull_*"]``)."""
+
+    requests: int            # requests that arrived at a live peer
+    responses: int           # value transfers
+    misses: int              # arrived requests that transferred nothing
+    dropped: int             # loss-dropped requests
+    suppressed: int          # partition-suppressed requests
+    rescued: dict            # {node index: pull hop} — push-unreached nodes
+                             # delivered via pull this round
+    egress: np.ndarray       # [N] i64 per-node pull egress (req out + resp out)
+    ingress: np.ndarray      # [N] i64 per-node pull ingress (req in + resp in)
+    peers: np.ndarray        # [N, PS] i16 sampled peer per slot (-1 inactive)
+    code: np.ndarray         # [N, PS] i8 PULL_* outcome per slot
+    pull_hop: np.ndarray     # [N] i16 pull delivery hop (-1 none)
+
+
+class PullOracle:
+    """CPU-oracle pull phase: the identical spec as the engine's
+    ``round/pull`` block (engine/core.py), implemented as plain per-node /
+    per-slot loops over the scalar counter hashes — an independent
+    formulation the 1k-node parity test (tests/test_pull.py) checks the
+    sort-routed engine against bit-for-bit."""
+
+    def __init__(self, stakes, *, seed: int = 0, pull_fanout: int = 2,
+                 pull_interval: int = 1, pull_bloom_fp_rate: float = 0.1,
+                 pull_request_cap: int = 0, pull_slots: int = 0,
+                 packet_loss_rate: float = 0.0, partition_at: int = -1,
+                 heal_at: int = -1):
+        stakes = np.asarray(stakes, dtype=np.int64)
+        self.n = int(stakes.shape[0])
+        self.tables = pull_class_tables(stakes)
+        self.seed = int(seed)
+        self.pull_fanout = int(pull_fanout)
+        self.pull_interval = max(1, int(pull_interval))
+        self.fp_thr = rate_threshold(pull_bloom_fp_rate)
+        self.cap = int(pull_request_cap)
+        self.pull_slots = int(pull_slots) if pull_slots > 0 else max(
+            8, self.pull_fanout)
+        self.loss_thr = rate_threshold(packet_loss_rate)
+        self.partition_at = int(partition_at)
+        self.heal_at = int(heal_at)
+        self.side = (stake_bipartition(stakes)
+                     if self.partition_at >= 0 else None)
+
+    def pull_round_active(self, it: int) -> bool:
+        return it % self.pull_interval == 0
+
+    def run_round(self, it: int, hops, failed) -> PullRound:
+        """Run one pull exchange against this round's push outcome.
+
+        ``hops``: [N] int, the push BFS hop distance per node (-1 =
+        unreached; the origin is 0).  ``failed``: [N] bool, the node-failure
+        mask in effect this round (post-churn).  Responses are based on the
+        push-reached state only — one request/response exchange per pull
+        round, no intra-round cascade."""
+        n, ps = self.n, self.pull_slots
+        hops = np.asarray(hops)
+        failed = np.asarray(failed, dtype=bool)
+        peers = np.full((n, ps), -1, np.int16)
+        code = np.zeros((n, ps), np.int8)
+        pull_hop = np.full(n, -1, np.int16)
+        egress = np.zeros(n, np.int64)
+        ingress = np.zeros(n, np.int64)
+        res = PullRound(0, 0, 0, 0, 0, {}, egress, ingress, peers, code,
+                        pull_hop)
+        if not self.pull_round_active(it):
+            return res
+        requests = responses = misses = dropped = suppressed = 0
+        rescued = {}
+        b_cls = round_basis(self.seed, it, SALT_PULL_CLASS)
+        b_mem = round_basis(self.seed, it, SALT_PULL_MEMBER)
+        b_fp = round_basis(self.seed, it, SALT_PULL_BLOOM)
+        b_loss = round_basis(self.seed, it, SALT_PULL_LOSS)
+        part_on = (self.side is not None
+                   and partition_active(it, self.partition_at, self.heal_at))
+        served = np.zeros(n, np.int64)   # requests answered per peer
+        for r in range(n):
+            if failed[r]:
+                continue
+            holds_r = hops[r] >= 0
+            fp_r = (self.fp_thr
+                    and node_u32(b_fp, r) < self.fp_thr)
+            best = -1
+            for s in range(min(self.pull_fanout, ps)):
+                peer = sample_pull_peer(self.tables, b_cls, b_mem, r, s)
+                if peer == r:
+                    continue   # self-draw: slot discarded
+                peers[r, s] = peer
+                if failed[peer]:
+                    code[r, s] = PULL_PEER_FAILED
+                    continue
+                if part_on and self.side[r] != self.side[peer]:
+                    code[r, s] = PULL_SUPPRESSED
+                    suppressed += 1
+                    continue
+                if (self.loss_thr
+                        and edge_u32(b_loss, r, peer) < self.loss_thr):
+                    code[r, s] = PULL_DROPPED
+                    dropped += 1
+                    continue
+                # arrived: requester egress + peer ingress
+                requests += 1
+                egress[r] += 1
+                ingress[peer] += 1
+                if self.cap > 0 and served[peer] >= self.cap:
+                    code[r, s] = PULL_MISS_CAPPED
+                    misses += 1
+                    continue
+                served[peer] += 1
+                if hops[peer] < 0:
+                    code[r, s] = PULL_MISS_NOT_HELD
+                    misses += 1
+                elif holds_r:
+                    code[r, s] = PULL_MISS_ALREADY_HELD
+                    misses += 1
+                elif fp_r:
+                    code[r, s] = PULL_MISS_BLOOM_FP
+                    misses += 1
+                else:
+                    code[r, s] = PULL_RESPONSE
+                    responses += 1
+                    egress[peer] += 1
+                    ingress[r] += 1
+                    h = int(hops[peer]) + 1
+                    best = h if best < 0 else min(best, h)
+            if best >= 0:
+                rescued[r] = best
+                pull_hop[r] = best
+        return PullRound(requests, responses, misses, dropped, suppressed,
+                         rescued, egress, ingress, peers, code, pull_hop)

@@ -15,8 +15,10 @@ Series and field names are the compatibility contract
 ``stranded_node_iterations``, ``stranded_node_histogram``,
 ``aggregate_hops_histogram``, ``{egress,ingress,prune}_message_count``.
 Extensions beyond the reference: ``delivery`` / ``coverage_recovery``
-(fault injection, faults.py) and ``sim_perf`` (runtime telemetry, obs/:
-round-block wall time, throughput, sender queue depth).
+(fault injection, faults.py), ``sim_perf`` (runtime telemetry, obs/:
+round-block wall time, throughput, sender queue depth), ``sim_trace``
+(flight-recorder segment flushes, obs/trace.py) and ``sim_pull``
+(pull-phase request/response/miss/rescue counters, pull.py).
 """
 
 from __future__ import annotations
@@ -282,6 +284,20 @@ class InfluxDataPoint:
             f"round_wall_s={round_wall_s},"
             f"origin_iters_per_sec={origin_iters_per_sec},"
             f"queue_depth={queue_depth},iters={iters} ")
+        self.append_timestamp()
+
+    def create_sim_pull_point(self, requests, responses, misses, dropped,
+                              suppressed, rescued):
+        """Pull-phase series (pull.py): request/response/miss message
+        counts plus loss/partition casualties and the nodes rescued by a
+        pull response — per-iteration on the single-origin path, run-level
+        means on the all-origins aggregate path."""
+        self.datapoint += (
+            f"sim_pull,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"requests={requests},responses={responses},"
+            f"misses={misses},dropped={dropped},"
+            f"suppressed={suppressed},rescued={rescued} ")
         self.append_timestamp()
 
     def create_sim_trace_point(self, rounds, delivered_edges, prunes,

@@ -75,10 +75,17 @@ class AllOriginsStats:
         self.dropped_stats = StatCollection("Dropped Messages")
         self.suppressed_stats = StatCollection("Suppressed Messages")
         self.failed_stats = StatCollection("Failed Nodes")
+        # pull-phase aggregates (pull.py); empty unless a pull mode ran
+        self.pull_requests_stats = StatCollection("Pull Requests")
+        self.pull_responses_stats = StatCollection("Pull Responses")
+        self.pull_misses_stats = StatCollection("Pull Misses")
+        self.pull_rescued_stats = StatCollection("Pull Rescued Nodes")
         self._chunks = {"coverage": [], "rmr": [], "branching": [],
                         "ldh": [], "delivered": [], "dropped": [],
-                        "suppressed": [],
-                        "failed": []}   # per-batch [measured*O] arrays
+                        "suppressed": [], "failed": [],
+                        "pull_requests": [], "pull_responses": [],
+                        "pull_misses": [],
+                        "pull_rescued": []}  # per-batch [measured*O] arrays
         self.hops_hist = np.zeros(hist_bins, np.int64)
         self.stranded_counts = np.zeros(self.N, np.int64)
         self.egress = np.zeros(self.N, np.int64)
@@ -92,6 +99,14 @@ class AllOriginsStats:
         self.total_dropped = 0           # loss-dropped messages (measured)
         self.total_suppressed = 0        # partition-suppressed (measured)
         self.impaired = False            # set by finalize(config)
+        self.pull = False                # a pull mode ran (set by finalize)
+        self.total_pull_requests = 0     # arrived pull requests (measured)
+        self.total_pull_responses = 0    # pull value transfers (measured)
+        self.total_pull_rescued = 0      # pull-rescued (origin, round) pairs
+        self.total_pull_dropped = 0      # loss-dropped pull requests
+        self.total_pull_suppressed = 0   # partition-suppressed pull requests
+        self.pull_hops_hist = np.zeros(hist_bins, np.int64)
+        self.pull_rescued_counts = np.zeros(self.N, np.int64)
         # per-origin iterations-to-recover coverage after heal (faults.py);
         # -1 = that origin never recovered within the run
         self.recovery_iters = []
@@ -107,7 +122,7 @@ class AllOriginsStats:
     # -- per-batch accumulation -------------------------------------------
 
     def add_batch(self, rows, state, warm_up_rounds: int, heal_at: int = -1,
-                  impaired: bool = False):
+                  impaired: bool = False, pull: bool = False):
         """Fold one origin batch's rows (leading [iters] axis) + final
         SimState accumulators (already warm-up-gated on device).
 
@@ -115,7 +130,8 @@ class AllOriginsStats:
         iterations-to-recover-coverage from the full (unwarmed) coverage
         series.  ``impaired`` gates the delivery-counter accumulation —
         the engine always emits the counter rows (all-zero when the knobs
-        are off), so unimpaired runs must not retain them."""
+        are off), so unimpaired runs must not retain them.  ``pull`` gates
+        the pull-phase counters (pull.py) the same way."""
         cov = np.asarray(rows["coverage"])[warm_up_rounds:]
         if cov.size:
             self._chunks["coverage"].append(
@@ -137,11 +153,32 @@ class AllOriginsStats:
                     self._chunks[key].append(
                         np.asarray(rows[row_key])[warm_up_rounds:]
                         .ravel().astype(np.float64))
+            if pull:
+                for key in ("pull_requests", "pull_responses",
+                            "pull_misses", "pull_rescued"):
+                    self._chunks[key].append(
+                        np.asarray(rows[key])[warm_up_rounds:]
+                        .ravel().astype(np.float64))
         if impaired:
             self.total_dropped += int(
                 np.asarray(rows["dropped"])[warm_up_rounds:].sum())
             self.total_suppressed += int(
                 np.asarray(rows["suppressed"])[warm_up_rounds:].sum())
+        if pull:
+            self.total_pull_requests += int(
+                np.asarray(rows["pull_requests"])[warm_up_rounds:].sum())
+            self.total_pull_responses += int(
+                np.asarray(rows["pull_responses"])[warm_up_rounds:].sum())
+            self.total_pull_rescued += int(
+                np.asarray(rows["pull_rescued"])[warm_up_rounds:].sum())
+            self.total_pull_dropped += int(
+                np.asarray(rows["pull_dropped"])[warm_up_rounds:].sum())
+            self.total_pull_suppressed += int(
+                np.asarray(rows["pull_suppressed"])[warm_up_rounds:].sum())
+            self.pull_hops_hist += np.asarray(
+                state.pull_hops_hist_acc, dtype=np.int64).sum(axis=0)
+            self.pull_rescued_counts += np.asarray(
+                state.pull_rescued_acc, dtype=np.int64).sum(axis=0)
         if "hop_clamped" in rows:
             # measured rounds only, matching the warm-up-gated hops
             # histogram this guard is about (and the single-origin path)
@@ -200,10 +237,15 @@ class AllOriginsStats:
         for sc, key in ((self.delivered_stats, "delivered"),
                         (self.dropped_stats, "dropped"),
                         (self.suppressed_stats, "suppressed"),
-                        (self.failed_stats, "failed")):
+                        (self.failed_stats, "failed"),
+                        (self.pull_requests_stats, "pull_requests"),
+                        (self.pull_responses_stats, "pull_responses"),
+                        (self.pull_misses_stats, "pull_misses"),
+                        (self.pull_rescued_stats, "pull_rescued")):
             self._fill_stat_collection(
                 sc, np.concatenate(self._chunks[key])
                 if self._chunks[key] else np.empty(0))
+        self.pull = bool(self._chunks["pull_requests"])
         self.aggregate_hops = HistogramHopsStat(self.hops_hist)
         # LDH = HopsStat over per-round maxima (gossip_stats.rs:196-210):
         # filter 0 (rounds where nobody beyond the origin was reached)
@@ -326,6 +368,16 @@ class AllOriginsStats:
                 self._print_sc(sc)
             log.info("Total dropped: %s  Total suppressed: %s",
                      self.total_dropped, self.total_suppressed)
+        if self.pull:
+            log.info("|---- PULL (ANTI-ENTROPY) STATS ----|")
+            for sc in (self.pull_requests_stats, self.pull_responses_stats,
+                       self.pull_misses_stats, self.pull_rescued_stats):
+                self._print_sc(sc)
+            log.info("Pull totals: %s requests, %s responses, %s rescued, "
+                     "%s dropped, %s suppressed",
+                     self.total_pull_requests, self.total_pull_responses,
+                     self.total_pull_rescued, self.total_pull_dropped,
+                     self.total_pull_suppressed)
         rec = self.recovery_summary()
         if rec is not None:
             log.info("|---- COVERAGE RECOVERY AFTER HEAL ----|")
@@ -370,6 +422,15 @@ class AllOriginsStats:
             dp.create_delivery_point(
                 self.delivered_stats.mean, self.dropped_stats.mean,
                 self.suppressed_stats.mean, self.failed_stats.mean)
+        if self.pull:
+            dp.create_sim_pull_point(
+                self.pull_requests_stats.mean, self.pull_responses_stats.mean,
+                self.pull_misses_stats.mean,
+                round(self.total_pull_dropped
+                      / max(self.measured_points, 1), 4),
+                round(self.total_pull_suppressed
+                      / max(self.measured_points, 1), 4),
+                self.pull_rescued_stats.mean)
         rec = self.recovery_summary()
         if rec is not None:
             dp.create_recovery_point(rec["origins"], rec["mean"],

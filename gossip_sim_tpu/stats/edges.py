@@ -34,6 +34,10 @@ CAUSE_DROPPED = "dropped"
 CAUSE_TARGET_FAILED = "target_failed"
 CAUSE_NO_SENDERS = "no_potential_senders"
 CAUSE_INCONSISTENT = "inconsistent_delivered"
+# push-stranded node rescued by the pull (anti-entropy) phase (pull.py):
+# not stranded in the stats layer, but the push-path failure analysis is
+# still reported so "pull papered over a push hole" stays visible
+CAUSE_RESCUED_BY_PULL = "rescued_by_pull"
 
 
 def delivered_mask(code: np.ndarray, dist: np.ndarray) -> np.ndarray:
@@ -103,7 +107,8 @@ def redundant_edge_counts(peers: np.ndarray, code: np.ndarray,
 
 def explain_stranded(active: np.ndarray, pruned: np.ndarray,
                      peers: np.ndarray, code: np.ndarray, dist: np.ndarray,
-                     failed: np.ndarray, origin: int) -> list:
+                     failed: np.ndarray, origin: int,
+                     pull_hop: np.ndarray | None = None) -> list:
     """Root-cause every stranded node of one round.
 
     A node is stranded when it is unreached and not failed (the stats
@@ -122,9 +127,15 @@ def explain_stranded(active: np.ndarray, pruned: np.ndarray,
     * ``inconsistent_delivered``  a reached sender's slot claims delivery —
       impossible for a stranded node; flags a corrupt trace
 
+    ``pull_hop`` (trace schema v2, pull modes): per-node pull delivery hop,
+    -1 = none.  A push-unreached node with a pull rescue is NOT stranded —
+    its entry carries ``rescued_by_pull`` in the summary (with the push-path
+    causes preserved), so the analysis still shows why push alone would
+    have stranded it.
+
     Returns ``[{node, causes: [{sender, slot, cause}], summary: {...}}]``
-    with one entry per stranded node (``causes`` empty and summary
-    ``no_potential_senders`` when nobody even pointed at it).
+    with one entry per push-unreached non-failed node (``causes`` empty and
+    summary ``no_potential_senders`` when nobody even pointed at it).
     """
     stranded = np.nonzero((dist < 0) & ~failed)[0]
     out = []
@@ -155,7 +166,14 @@ def explain_stranded(active: np.ndarray, pruned: np.ndarray,
             summary[c["cause"]] = summary.get(c["cause"], 0) + 1
         if not causes:
             summary[CAUSE_NO_SENDERS] = 1
-        out.append({"node": int(r), "causes": causes, "summary": summary})
+        entry = {"node": int(r), "causes": causes, "summary": summary}
+        if pull_hop is not None and pull_hop[r] >= 0:
+            summary[CAUSE_RESCUED_BY_PULL] = 1
+            entry["pull_hop"] = int(pull_hop[r])
+            entry["stranded"] = False
+        elif pull_hop is not None:
+            entry["stranded"] = True
+        out.append(entry)
     return out
 
 

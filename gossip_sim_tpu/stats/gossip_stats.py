@@ -39,6 +39,11 @@ class SimulationParameters:
     churn_recover_rate: float = 0.0
     partition_at: int = -1
     heal_at: int = -1
+    gossip_mode: str = "push"
+    pull_fanout: int = 0
+    pull_interval: int = 1
+    pull_bloom_fp_rate: float = 0.0
+    pull_request_cap: int = 0
     test_type: Testing = Testing.NO_TEST
     num_simulations: int = 0
     step_size: StepSize = field(default_factory=lambda: StepSize(0, True))
@@ -63,6 +68,14 @@ class GossipStats:
         self.dropped_stats = StatCollection("Dropped Messages")
         self.suppressed_stats = StatCollection("Suppressed Messages")
         self.failed_count_series = []
+        # pull-phase series (pull.py); empty unless a pull mode ran
+        self.pull_requests_stats = StatCollection("Pull Requests")
+        self.pull_responses_stats = StatCollection("Pull Responses")
+        self.pull_misses_stats = StatCollection("Pull Misses")
+        self.pull_dropped_stats = StatCollection("Pull Dropped Requests")
+        self.pull_suppressed_stats = StatCollection(
+            "Pull Suppressed Requests")
+        self.pull_rescued_stats = StatCollection("Pull Rescued Nodes")
         # iterations from heal_at until coverage regained the recovery
         # threshold; None = no heal configured or never measured, -1 = never
         # recovered within the run
@@ -91,6 +104,11 @@ class GossipStats:
             churn_recover_rate=config.churn_recover_rate,
             partition_at=config.partition_at,
             heal_at=config.heal_at,
+            gossip_mode=config.gossip_mode,
+            pull_fanout=config.pull_fanout,
+            pull_interval=config.pull_interval,
+            pull_bloom_fp_rate=config.pull_bloom_fp_rate,
+            pull_request_cap=config.pull_request_cap,
             test_type=config.test_type,
             num_simulations=config.num_simulations,
             step_size=config.step_size,
@@ -146,6 +164,19 @@ class GossipStats:
 
     def has_delivery_stats(self):
         return not self.delivered_stats.is_empty()
+
+    def insert_pull(self, requests, responses, misses, dropped, suppressed,
+                    rescued):
+        """Per-round pull-phase counters (pull.py)."""
+        self.pull_requests_stats.push(requests)
+        self.pull_responses_stats.push(responses)
+        self.pull_misses_stats.push(misses)
+        self.pull_dropped_stats.push(dropped)
+        self.pull_suppressed_stats.push(suppressed)
+        self.pull_rescued_stats.push(rescued)
+
+    def has_pull_stats(self):
+        return not self.pull_requests_stats.is_empty()
 
     def note_post_heal_coverage(self, it, coverage):
         """Record one post-heal (iteration, coverage) sample.  Both backends
@@ -211,6 +242,11 @@ class GossipStats:
             self.delivered_stats.calculate_stats()
             self.dropped_stats.calculate_stats()
             self.suppressed_stats.calculate_stats()
+        if self.has_pull_stats():
+            for sc in (self.pull_requests_stats, self.pull_responses_stats,
+                       self.pull_misses_stats, self.pull_dropped_stats,
+                       self.pull_suppressed_stats, self.pull_rescued_stats):
+                sc.calculate_stats()
         sp = self.simulation_parameters
         if sp.heal_at >= 0:
             self.calc_recovery_iterations(sp.heal_at)
@@ -349,6 +385,14 @@ class GossipStats:
             if self.failed_count_series:
                 log.info("Failed nodes (last measured round): %s",
                          self.failed_count_series[-1])
+        if self.has_pull_stats():
+            log.info("|---- PULL (ANTI-ENTROPY) STATS ----|")
+            for sc in (self.pull_requests_stats, self.pull_responses_stats,
+                       self.pull_misses_stats, self.pull_rescued_stats):
+                self._print_stat_collection(sc)
+            log.info("Pull dropped total: %s  Pull suppressed total: %s",
+                     int(sum(self.pull_dropped_stats.collection)),
+                     int(sum(self.pull_suppressed_stats.collection)))
         if self.recovery_iterations is not None:
             if self.recovery_iterations >= 0:
                 log.info("Coverage recovered %s iteration(s) after heal",

@@ -113,11 +113,52 @@ def test_v3_checkpoint_records_impair_block(tmp_path):
     path = str(tmp_path / "ckpt.npz")
     save_state(path, state, params, iteration=4)
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 3
+    assert meta["format_version"] == 4
     assert meta["impair"] == {
         "packet_loss_rate": 0.25, "churn_fail_rate": 0.01,
         "churn_recover_rate": 0.5, "partition_at": 3, "heal_at": 8,
         "impair_seed": 77}
+    # v4: the pull meta block records the (default push) schedule
+    assert meta["pull"]["gossip_mode"] == "push"
+
+
+def test_v4_checkpoint_records_pull_block(tmp_path):
+    params, tables, origins, state = _setup()
+    params = params._replace(gossip_mode="push-pull", pull_fanout=4,
+                             pull_interval=2, pull_bloom_fp_rate=0.2,
+                             pull_request_cap=3)
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, params, iteration=2)
+    _, _, meta = restore_sim_state(path, params)
+    assert meta["pull"] == {
+        "gossip_mode": "push-pull", "pull_fanout": 4, "pull_interval": 2,
+        "pull_bloom_fp_rate": 0.2, "pull_request_cap": 3}
+
+
+def test_pre_v4_checkpoint_backfills_pull_state(tmp_path):
+    """A checkpoint without the pull accumulators (pre-v4 writer) loads
+    with exact zero backfill — no pull round ever ran before v4."""
+    import numpy as np
+
+    params, tables, origins, state = _setup()
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, params, iteration=1)
+    # simulate a pre-v4 file: strip the pull arrays + meta block
+    import json as _json
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != "__meta__"
+                  and not k.endswith("pull_hops_hist_acc")
+                  and not k.endswith("pull_rescued_acc")}
+        meta = _json.loads(bytes(z["__meta__"]).decode())
+    meta["format_version"] = 3
+    meta.pop("pull", None)
+    with open(path, "wb") as f:
+        np.savez_compressed(f, __meta__=np.frombuffer(
+            _json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    restored, _, meta2 = restore_sim_state(path, params, tables)
+    assert meta2["pull"]["gossip_mode"] == "push"
+    assert (np.asarray(restored.pull_hops_hist_acc) == 0).all()
+    assert (np.asarray(restored.pull_rescued_acc) == 0).all()
 
 
 def test_v2_checkpoint_backfills_all_off_impair(tmp_path):

@@ -162,6 +162,16 @@ def test_delivery_and_recovery_line_protocol():
         "origins=3,mean_iters=4.5,max_iters=9,unrecovered=2 ")
 
 
+def test_sim_pull_line_protocol():
+    """Pull-phase series (pull.py): request/response/miss/rescue fields."""
+    dp = InfluxDataPoint("9", 2)
+    dp.create_sim_pull_point(240, 12, 228, 30, 0, 8)
+    assert dp.data().startswith(
+        "sim_pull,simulation_iter=2,start_time=9 "
+        "requests=240,responses=12,misses=228,dropped=30,"
+        "suppressed=0,rescued=8 ")
+
+
 def _start_capture_server():
     _CapturingHandler.received = []
     server = http.server.HTTPServer(("127.0.0.1", 0), _CapturingHandler)
@@ -193,7 +203,7 @@ def test_all_origins_influx_end_to_end():
         cfg = Config(gossip_iterations=10, warm_up_rounds=4,
                      all_origins=True, origin_batch=16, mesh_devices=1,
                      packet_loss_rate=0.1, partition_at=5, heal_at=7,
-                     seed=3)
+                     seed=3, gossip_mode="push-pull", pull_fanout=3)
         summary = run_all_origins(cfg, "", dp_queue=q, start_ts="55",
                                   accounts=accounts)
         assert summary["measured_points"] == 6 * 32
@@ -208,12 +218,15 @@ def test_all_origins_influx_end_to_end():
                        "stranded_node_iterations,",
                        "egress_message_count,", "ingress_message_count,",
                        "prune_message_count,", "delivery,",
-                       "coverage_recovery,"):
+                       "coverage_recovery,", "sim_pull,"):
             assert series in wire, f"missing aggregate series {series}"
         # degraded-delivery fields carry the measured loss
         agg = summary["stats"]
         assert agg.total_dropped > 0
         assert f"dropped={agg.dropped_stats.mean}" in wire
+        # pull aggregates made it to the wire (ISSUE 5: sim_pull series)
+        assert agg.total_pull_requests > 0
+        assert f"requests={agg.pull_requests_stats.mean}" in wire
     finally:
         server.shutdown()
 

@@ -272,6 +272,33 @@ class TestWriterLoader:
         with pytest.raises(KeyError):
             tr.pos_of(99)
 
+    def test_v1_trace_still_readable(self, tmp_path):
+        """ISSUE 5: trace schema v2 must keep v1 captures loadable — a v1
+        manifest (no gossip_mode/pull_slots/pull arrays) validates and
+        loads with the base array set."""
+        import json
+
+        from gossip_sim_tpu.obs.trace import (MANIFEST_NAME,
+                                              TRACE_SCHEMA_V1)
+
+        w, block = self._write(tmp_path)
+        w.add_block(0, block)
+        w.finalize()
+        mpath = str(tmp_path / MANIFEST_NAME)
+        with open(mpath) as f:
+            m = json.load(f)
+        # rewrite as a v1 manifest (what a pre-pull writer produced)
+        m["schema"] = TRACE_SCHEMA_V1
+        for key in ("gossip_mode", "pull_slots", "pull_codes"):
+            m.pop(key, None)
+        with open(mpath, "w") as f:
+            json.dump(m, f)
+        assert validate_trace_manifest(m) == []
+        assert validate_trace_dir(str(tmp_path)) == []
+        tr = load_trace(str(tmp_path))
+        assert set(tr.arrays) == set(ARRAY_SPECS)
+        assert len(tr) == 8
+
     def test_overlapping_segment_replaced_not_duplicated(self, tmp_path):
         w, block = self._write(tmp_path)
         w.add_block(0, {k: v[:6] for k, v in block.items()})

@@ -1,0 +1,66 @@
+"""Run every fast CI smoke gate in sequence (CPU, ~2 min total).
+
+The gates, in dependency-light-first order:
+
+  chaos_smoke   fault-injection invariants (loss/churn/partition)
+  obs_smoke     run-report schema + telemetry overhead < 2%
+  trace_smoke   flight-recorder schema/parity/overhead
+  sweep_smoke   compile-once sweeps (1 compile across a knob sweep)
+  pull_smoke    pull-gossip subsystem (healing, zero bit-impact, parity)
+
+Usage: python tools/ci_gates.py [--only NAME[,NAME...]]
+
+Exit code 0 = every gate passed; 1 = at least one failed (each gate's
+output streams through, and a summary table prints at the end).
+"""
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
+         "pull_smoke"]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description="run all CI smoke gates")
+    ap.add_argument("--only", default="",
+                    help="comma-separated subset of gates to run")
+    ap.add_argument("--timeout", type=int, default=600,
+                    help="per-gate hard timeout (seconds)")
+    args = ap.parse_args()
+    selected = ([g.strip() for g in args.only.split(",") if g.strip()]
+                if args.only else GATES)
+    unknown = [g for g in selected if g not in GATES]
+    if unknown:
+        print(f"unknown gate(s): {unknown}; have {GATES}")
+        return 2
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    results = []
+    for gate in selected:
+        print(f"\n===== {gate} =====", flush=True)
+        t0 = time.time()
+        try:
+            rc = subprocess.run(
+                [sys.executable, os.path.join(HERE, f"{gate}.py")],
+                env=env, timeout=args.timeout).returncode
+        except subprocess.TimeoutExpired:
+            rc = -9
+        results.append((gate, rc, round(time.time() - t0, 1)))
+
+    print("\n===== CI gate summary =====")
+    failed = 0
+    for gate, rc, dt in results:
+        status = "PASS" if rc == 0 else ("TIMEOUT" if rc == -9
+                                         else f"FAIL rc={rc}")
+        failed += rc != 0
+        print(f"  {gate:<14} {status:<12} {dt}s")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,151 @@
+"""Pull-gossip smoke test: the anti-entropy subsystem's CI gate (pull.py).
+
+Fast CPU gate (<60s) over three contracts:
+
+  1. **Healing**: under heavy packet loss (default 20%), push-pull mode's
+     mean measured coverage is >= push-only's, and pull actually rescues
+     stranded nodes (nonzero rescue count).
+  2. **Zero bit-impact**: with --gossip-mode push, every engine row and
+     every SimState array is bit-identical to the engine's defaults — the
+     pull subsystem must be invisible when off.
+  3. **Oracle parity at 1k nodes**: the sort-routed engine's pull phase and
+     the loop-based PullOracle (pull.py) make bit-identical decisions
+     round by round (requests/responses/misses/drops/rescues and per-node
+     pull hops) under combined packet loss + churn.
+
+Usage: python tools/pull_smoke.py [--num-nodes 1000] [--seed 11]
+       [--packet-loss 0.2] [--pull-fanout 3] [--iterations 24]
+
+Exit code 0 = all gates hold; 1 = a pull invariant failed.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="pull-gossip subsystem smoke (CPU, <60s)")
+    ap.add_argument("--num-nodes", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--packet-loss", type=float, default=0.2)
+    ap.add_argument("--pull-fanout", type=int, default=3)
+    ap.add_argument("--pull-bloom-fp", type=float, default=0.1)
+    ap.add_argument("--churn-fail", type=float, default=0.01)
+    ap.add_argument("--churn-recover", type=float, default=0.2)
+    ap.add_argument("--iterations", type=int, default=24)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                       make_cluster_tables, run_rounds)
+    from gossip_sim_tpu.pull import PullOracle
+
+    t0 = time.time()
+    n, iters = args.num_nodes, args.iterations
+    rng = np.random.default_rng(args.seed)
+    stakes = rng.choice(np.arange(1, 50 * n), size=n,
+                        replace=False).astype(np.int64) * 10**9
+    tables = make_cluster_tables(stakes)
+    origins = jnp.arange(1, dtype=jnp.int32)
+
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    def run(params, **kw):
+        state = init_state(jax.random.PRNGKey(args.seed), tables, origins,
+                           params)
+        state, rows = run_rounds(params, tables, origins, state, iters, **kw)
+        return state, jax.tree_util.tree_map(np.asarray, rows)
+
+    print(f"pull smoke: n={n} loss={args.packet_loss} "
+          f"pull_fanout={args.pull_fanout} iters={iters}")
+
+    # ---- gate 1: push-pull heals a lossy network -------------------------
+    lossy = EngineParams(num_nodes=n, warm_up_rounds=0,
+                         packet_loss_rate=args.packet_loss,
+                         churn_fail_rate=args.churn_fail,
+                         churn_recover_rate=args.churn_recover,
+                         impair_seed=args.seed).validate()
+    pp = lossy._replace(gossip_mode="push-pull",
+                        pull_fanout=args.pull_fanout,
+                        pull_bloom_fp_rate=args.pull_bloom_fp).validate()
+    _, r_push = run(lossy)
+    _, r_pp = run(pp)
+    cov_push = float(r_push["coverage"].mean())
+    cov_pp = float(r_pp["coverage"].mean())
+    rescued = int(r_pp["pull_rescued"].sum())
+    print(f"  coverage: push-only={cov_push:.4f} push-pull={cov_pp:.4f} "
+          f"rescued={rescued}")
+    check(cov_pp >= cov_push,
+          f"push-pull coverage >= push-only under {args.packet_loss:.0%} "
+          f"loss ({cov_pp:.4f} vs {cov_push:.4f})")
+    check((r_pp["coverage"] >= r_push["coverage"]).all(),
+          "per-round coverage never drops below the push-only run")
+    check(rescued > 0, "pull responses rescued stranded nodes")
+    check(int((r_pp["pull_requests"]
+               - r_pp["pull_responses"] - r_pp["pull_misses"]).sum()) == 0,
+          "request accounting closes (requests == responses + misses)")
+
+    # ---- gate 2: mode=push has zero bit-impact ---------------------------
+    base = EngineParams(num_nodes=n, warm_up_rounds=0).validate()
+    off = base._replace(gossip_mode="push", pull_fanout=7,
+                        pull_bloom_fp_rate=0.5, pull_request_cap=2)
+    s_a, r_a = run(base, detail=True)
+    s_b, r_b = run(off, detail=True)
+    bit_ok = set(r_a) == set(r_b) and "pull_requests" not in r_a
+    for k in r_a:
+        bit_ok &= bool(np.array_equal(r_a[k], r_b[k]))
+    for f in s_a._fields:
+        bit_ok &= bool(np.array_equal(np.asarray(getattr(s_a, f)),
+                                      np.asarray(getattr(s_b, f))))
+    check(bit_ok, "mode=push is bit-identical to the pre-pull engine "
+                  "(rows + state, pull knobs ignored)")
+
+    # ---- gate 3: 1k-node engine-vs-oracle pull parity --------------------
+    _, rows = run(pp, detail=True)
+    po = PullOracle(stakes, seed=args.seed, pull_fanout=args.pull_fanout,
+                    pull_bloom_fp_rate=args.pull_bloom_fp,
+                    pull_slots=pp.pull_slots_resolved,
+                    packet_loss_rate=args.packet_loss)
+    mismatches = 0
+    for r in range(iters):
+        res = po.run_round(r, rows["dist"][r, 0], rows["failed_mask"][r, 0])
+        for name, val in (("pull_requests", res.requests),
+                          ("pull_responses", res.responses),
+                          ("pull_misses", res.misses),
+                          ("pull_dropped", res.dropped),
+                          ("pull_rescued", len(res.rescued))):
+            if int(rows[name][r, 0]) != int(val):
+                mismatches += 1
+        if not np.array_equal(rows["pull_hop"][r, 0],
+                              res.pull_hop.astype(np.int32)):
+            mismatches += 1
+    check(mismatches == 0,
+          f"engine pull phase bit-matches PullOracle across {iters} rounds "
+          f"at n={n} under loss+churn")
+
+    dt = time.time() - t0
+    print(f"  elapsed: {dt:.1f}s")
+    if failures:
+        print(f"PULL SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("PULL SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,7 @@
 """Offline analysis of protocol flight-recorder traces (obs/trace.py).
 
-Subcommands over a ``--trace-dir`` capture (schema gossip-sim-tpu/trace/v1):
+Subcommands over a ``--trace-dir`` capture (schema gossip-sim-tpu/trace/v2;
+v1 traces load too — they just carry no pull arrays):
 
   info DIR                      manifest summary + on-disk validation
   tree DIR [--round R]          reconstruct + render the delivery tree
@@ -135,14 +136,20 @@ def cmd_explain_stranded(args) -> int:
     rnd, col = _round_and_col(tr, args)
     origin = tr.origins[col]
     s = _round_slice(tr, rnd, col)
+    # v2 pull traces: pass the pull hops so push-stranded nodes that a
+    # pull response rescued are tagged rescued_by_pull instead of stranded
     explained = E.explain_stranded(s["active"], s["pruned"], s["peers"],
-                                   s["code"], s["dist"], s["failed"], origin)
+                                   s["code"], s["dist"], s["failed"], origin,
+                                   pull_hop=s.get("pull_hop"))
     if args.json:
         print(json.dumps({"round": rnd, "origin": origin,
                           "stranded": explained}, indent=2))
         return 0
+    n_rescued = sum(1 for ent in explained
+                    if E.CAUSE_RESCUED_BY_PULL in ent["summary"])
+    tag = (f" ({n_rescued} rescued by pull)" if n_rescued else "")
     print(f"stranded nodes: round {rnd}, origin {origin} -> "
-          f"{len(explained)} stranded")
+          f"{len(explained) - n_rescued} stranded{tag}")
     for ent in explained:
         causes = ent["summary"]
         top = ", ".join(f"{k}={v}" for k, v in
