@@ -20,6 +20,11 @@ falls back to a small CPU run so a number is always printed.  Diagnostics
 The worker's init_s/compile_s/elapsed_s come from the shared obs span
 registry (gossip_sim_tpu/obs/) — the same spans ``--run-report`` emits —
 so BENCH trajectory lines and product run reports are directly comparable.
+Two sweep rungs ride along: ``sweep_steps_per_sec`` (serial warm-executable
+sweep steps, ISSUE 4) and ``lane_sweep_steps_per_sec`` (the same per-point
+work as one lane-batched device program, engine/lanes.py / ISSUE 6 —
+their ratio is the lane amortization factor the 10x ROADMAP target is
+about).
 A slow-waking TPU gets more than one probe window via
 ``GOSSIP_BENCH_PROBE_TIMEOUT`` (seconds per attempt, default 150) and
 ``GOSSIP_BENCH_PROBE_TRIES`` (attempts, default 3) — but a probe that
@@ -158,6 +163,35 @@ def worker(args) -> int:
     sweep_compiles = (compiled_cache_size() - c_before
                       if c_before >= 0 else -1)
 
+    # ---- lane rung: the sweep axis as ONE batched device program -------
+    # (engine/lanes.py, ISSUE 6).  Same per-point work as the serial sweep
+    # rung above — sweep_iters rounds at the same (n, o) — but all lanes
+    # execute inside one compiled call, so the two rungs' steps/sec are
+    # directly comparable: lane_sweep_steps_per_sec / sweep_steps_per_sec
+    # is the lane amortization factor (the 10x ROADMAP target is an
+    # accelerator number; a compute-bound CPU sees ~1x minus vmap
+    # overhead, which this rung tracks honestly).
+    from gossip_sim_tpu.engine import (broadcast_state, lane_cache_size,
+                                       run_rounds_lanes, stack_knobs)
+    lanes = max(1, args.lane_sweep_lanes)
+    static = params.static_part()
+    lane_knobs = stack_knobs([sweep_params(k).knob_values()
+                              for k in range(1, lanes + 1)])
+    t_lc = time.perf_counter()
+    lstates, lrows = run_rounds_lanes(
+        static, tables, origins, broadcast_state(state, lanes), lane_knobs,
+        sweep_iters, start_it=it_at)
+    jax.block_until_ready(lrows["coverage"])
+    lane_compile_dt = time.perf_counter() - t_lc
+    c_warm = lane_cache_size()
+    t_lane = time.perf_counter()
+    lstates, lrows = run_rounds_lanes(
+        static, tables, origins, broadcast_state(state, lanes), lane_knobs,
+        sweep_iters, start_it=it_at)
+    jax.block_until_ready(lrows["coverage"])
+    lane_dt = time.perf_counter() - t_lane
+    lane_compiles = (lane_cache_size() - c_warm if c_warm >= 0 else -1)
+
     result = bench_summary(
         reg, platform=platform, num_nodes=n, origin_batch=o,
         iterations=args.iterations,
@@ -169,6 +203,19 @@ def worker(args) -> int:
         "iters_per_step": sweep_iters,
         "warm_steps_elapsed_s": round(sweep_dt, 3),
         "compiles_during_warm_steps": sweep_compiles,
+    }
+    result["lane_sweep_steps_per_sec"] = round(
+        lanes / lane_dt, 2) if lane_dt > 0 else 0.0
+    result["lane_sweep"] = {
+        "lanes": lanes,
+        "iters_per_step": sweep_iters,
+        "warm_elapsed_s": round(lane_dt, 3),
+        "first_call_elapsed_s": round(lane_compile_dt, 3),
+        "compiles_during_warm_steps": lane_compiles,
+        "vs_serial_sweep": (round((lanes / lane_dt) /
+                                  (sweep_steps / sweep_dt), 3)
+                            if lane_dt > 0 and sweep_dt > 0
+                            and sweep_steps else 0.0),
     }
     pc = persistent_cache_counters()
     result["compilation_cache"] = {
@@ -215,7 +262,10 @@ PROBE_CACHE_TTL = max(0.0, _env_number("GOSSIP_BENCH_PROBE_CACHE_TTL",
 
 
 def _read_probe_cache():
-    """-> age_seconds of a cached probe FAILURE, or None."""
+    """-> (age_seconds, failure_reason) of a cached probe FAILURE, or
+    None.  The reason is whatever diagnostic the failing probe recorded
+    (timeout tail, error text) so a CPU-fallback BENCH line can say WHY
+    it is a CPU line instead of silently reporting CPU numbers."""
     path = _probe_cache_path()
     if not path or not os.path.exists(path):
         return None
@@ -223,18 +273,20 @@ def _read_probe_cache():
         with open(path) as f:
             entry = json.load(f)
         age = time.time() - float(entry["ts"])
+        reason = str(entry.get("reason", "unknown"))
     except (OSError, ValueError, KeyError, TypeError):
         return None
-    return age if 0 <= age < PROBE_CACHE_TTL else None
+    return (age, reason) if 0 <= age < PROBE_CACHE_TTL else None
 
 
-def _write_probe_cache():
+def _write_probe_cache(reason: str = ""):
     path = _probe_cache_path()
     if not path:
         return
     try:
         with open(path, "w") as f:
-            json.dump({"ts": time.time(), "platform": None}, f)
+            json.dump({"ts": time.time(), "platform": None,
+                       "reason": reason[-500:]}, f)
     except OSError:
         pass
 
@@ -256,19 +308,25 @@ def probe_backend():
       straight to the CPU fallback rung.  Successes are never cached — a
       freshly-revived accelerator is always picked up.
 
-    Returns (platform_or_None, diagnostics list)."""
+    Returns (platform_or_None, diagnostics list, cached_failure_or_None);
+    the third element is ``{"age_s":..., "reason":...}`` exactly when the
+    probe was skipped because of a cached failure — main() stamps it into
+    the BENCH json (``probe_cached_failure``) so CPU-fallback numbers are
+    never silent about why they are CPU numbers."""
     code = ("import jax, json; d = jax.devices(); "
             "print(json.dumps({'platform': d[0].platform, 'n': len(d), "
             "'version': jax.__version__}))")
     diags = []
-    cached_age = _read_probe_cache()
-    if cached_age is not None:
+    cached = _read_probe_cache()
+    if cached is not None:
+        age, reason = cached
         diags.append(
-            f"probe skipped: cached failure {round(cached_age)}s ago "
+            f"probe skipped: cached failure {round(age)}s ago "
             f"(< ttl {round(PROBE_CACHE_TTL)}s; delete "
             f"{_probe_cache_path()} or set GOSSIP_BENCH_PROBE_CACHE=off "
             f"to force a probe)")
-        return None, diags
+        return None, diags, {"age_s": round(age, 1), "reason": reason}
+    last_err = ""
     for attempt in range(PROBE_RETRIES):
         t0 = time.time()
         rc, out, err = _run_sub([sys.executable, "-c", code], PROBE_TIMEOUT)
@@ -277,26 +335,29 @@ def probe_backend():
             try:
                 info = json.loads(out.strip().splitlines()[-1])
                 diags.append(f"probe[{attempt}] ok in {dt}s: {info}")
-                return info["platform"], diags
+                return info["platform"], diags, None
             except (ValueError, KeyError) as e:
                 diags.append(f"probe[{attempt}] unparseable ({e}): {out[:200]}")
+                last_err = f"unparseable probe output: {out[:200]}"
         else:
             diags.append(f"probe[{attempt}] rc={rc} in {dt}s: {err[-300:]}")
+            last_err = f"rc={rc} in {dt}s: {err[-300:]}"
         if rc == -9:
             diags.append("probe hung to the hard timeout; not retrying "
                          "(a hung backend does not heal in seconds)")
             break
         if attempt < PROBE_RETRIES - 1:
             time.sleep(min(10 * (attempt + 1), 30))
-    _write_probe_cache()
-    return None, diags
+    _write_probe_cache(last_err)
+    return None, diags, None
 
 
-def run_rung(n, o, iters, warmup, tmo, env, diags, label=""):
+def run_rung(n, o, iters, warmup, tmo, env, diags, label="", lanes=32):
     """Spawn one worker rung; returns its parsed JSON or None."""
     cmd = [sys.executable, os.path.abspath(__file__), "--worker",
            "--num-nodes", str(n), "--origin-batch", str(o),
-           "--iterations", str(iters), "--warmup-timing", str(warmup)]
+           "--iterations", str(iters), "--warmup-timing", str(warmup),
+           "--lane-sweep-lanes", str(lanes)]
     t0 = time.time()
     rc, out, err = _run_sub(cmd, tmo, env=env)
     dt = round(time.time() - t0, 1)
@@ -323,6 +384,11 @@ def main():
     ap.add_argument("--sweep-steps", type=int, default=3,
                     help="warm-executable sweep steps timed for the "
                          "sweep_steps_per_sec rung")
+    ap.add_argument("--lane-sweep-lanes", type=int, default=32,
+                    help="lanes for the lane_sweep_steps_per_sec rung "
+                         "(the device-resident sweep grid; the CPU "
+                         "fallback rung scales this down to 8 to fit its "
+                         "timeout)")
     ap.add_argument("--worker", action="store_true",
                     help="internal: run the measurement in-process")
     ap.add_argument("--timeout", type=int, default=0,
@@ -333,12 +399,14 @@ def main():
         return worker(args)
 
     diags = []
-    platform, probe_diags = probe_backend()
+    platform, probe_diags, cached_failure = probe_backend()
     diags += probe_diags
 
-    if platform is None or platform == "cpu":
+    cpu_mode = platform is None or platform == "cpu"
+    if cpu_mode:
         # Accelerator missing or down: pin CPU so the worker cannot hang on
-        # accelerator init, run one small rung.
+        # accelerator init, run one small rung (8 lanes: a 32-lane rung at
+        # CPU round times would blow the rung timeout).
         rungs = [CPU_RUNG]
         env = dict(os.environ, JAX_PLATFORMS="cpu", GOSSIP_BENCH_FORCE_CPU="1")
         diags.append("accelerator unavailable -> CPU fallback" if platform
@@ -346,6 +414,8 @@ def main():
     else:
         rungs = LADDER
         env = dict(os.environ)
+    lanes = (min(args.lane_sweep_lanes, 8) if cpu_mode
+             else args.lane_sweep_lanes)
 
     if args.num_nodes > 0:  # manual rung
         rungs = [(args.num_nodes, args.origin_batch, args.iterations,
@@ -354,7 +424,7 @@ def main():
     result = None
     for (n, o, iters, tmo) in rungs:
         result = run_rung(n, o, iters, args.warmup_timing,
-                          args.timeout or tmo, env, diags)
+                          args.timeout or tmo, env, diags, lanes=lanes)
         if result is not None:
             break
 
@@ -364,17 +434,25 @@ def main():
                        GOSSIP_BENCH_FORCE_CPU="1")
         n, o, iters, tmo = CPU_RUNG
         result = run_rung(n, o, iters, args.warmup_timing, tmo, cpu_env,
-                          diags, label="[cpu-fallback]")
+                          diags, label="[cpu-fallback]",
+                          lanes=min(args.lane_sweep_lanes, 8))
 
     if result is None:
-        print(json.dumps({
+        out = {
             "metric": "origin_iters_per_sec", "value": 0.0,
             "unit": "origin*iters/s", "vs_baseline": 0.0,
             "platform": platform or "unavailable", "error": "all rungs failed",
             "diagnostics": diags,
-        }))
+        }
+        if cached_failure is not None:
+            out["probe_cached_failure"] = cached_failure
+        print(json.dumps(out))
         return 1
 
+    if cached_failure is not None:
+        # never silently report CPU numbers off a cached probe failure:
+        # say why the accelerator was skipped and how stale that verdict is
+        result["probe_cached_failure"] = cached_failure
     result["diagnostics"] = diags
     print(json.dumps(result))
     return 0

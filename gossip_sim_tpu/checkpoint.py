@@ -60,6 +60,25 @@ _PULL_FIELDS = ("gossip_mode", "pull_fanout", "pull_interval",
 _PULL_DEFAULTS = {f: EngineParams._field_defaults[f] for f in _PULL_FIELDS}
 
 
+def guard_lane_checkpoint(config) -> None:
+    """No mid-sweep checkpoint in lane mode (ISSUE 6, explicit guard).
+
+    A lane-batched sweep evolves K sims inside one ``[K, O, ...]`` device
+    state and runs the whole simulation as a single scan — there is no
+    per-sim iteration boundary to checkpoint at, and a resumed lane batch
+    would need every lane's knobs and the exact lane packing to be
+    restored together.  Until a lane-aware checkpoint format exists, the
+    combination is rejected up front rather than silently writing a
+    checkpoint only the first lane could ever resume from."""
+    if getattr(config, "checkpoint_path", "") or getattr(config,
+                                                         "resume_path", ""):
+        raise SystemExit(
+            "ERROR: --checkpoint-path/--resume are not supported with "
+            "--sweep-lanes (no mid-sweep checkpoint in lane mode): a lane "
+            "batch runs the whole K-sim sweep inside one device program. "
+            "Drop --sweep-lanes to checkpoint/resume a serial sweep.")
+
+
 def save_state(path: str, state, params, config=None,
                iteration: int = 0) -> None:
     """Write SimState + EngineParams (+ optional Config) to one .npz.

@@ -136,6 +136,31 @@ def _pull_params(config) -> dict:
                 pull_request_cap=config.pull_request_cap)
 
 
+def _engine_params(config, num_nodes: int):
+    """The EngineParams a Config selects (engine/params.py) — the single
+    construction every TPU run path (single-sim, origin-rank sweep, lane
+    sweep) resolves through, so their compile keys and knob vectors can
+    never drift.  The one-shot fail event only arms on a FAIL_NODES run,
+    matching the reference's sweep gating (gossip_main.rs:449-452)."""
+    from .engine import EngineParams
+    return EngineParams(
+        num_nodes=num_nodes,
+        push_fanout=config.gossip_push_fanout,
+        active_set_size=config.gossip_active_set_size,
+        probability_of_rotation=config.probability_of_rotation,
+        prune_stake_threshold=config.prune_stake_threshold,
+        min_ingress_nodes=config.min_ingress_nodes,
+        warm_up_rounds=config.warm_up_rounds,
+        fail_at=(config.when_to_fail
+                 if config.test_type == Testing.FAIL_NODES else -1),
+        fail_fraction=(config.fraction_to_fail
+                       if config.test_type == Testing.FAIL_NODES else 0.0),
+        trace_prune_cap=config.trace_prune_cap,
+        **_impair_params(config),
+        **_pull_params(config),
+    )
+
+
 def _make_pull_oracle(config, index):
     """Oracle-side pull driver (pull.PullOracle), or None for push mode."""
     if not config.has_pull:
@@ -297,6 +322,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--origin-batch", type=int, default=0,
                    help="origins per device batch in --all-origins mode "
                         "(0 = auto)")
+    p.add_argument("--sweep-lanes", type=int, default=0,
+                   help="tpu backend: run a traced-knob sweep (packet-loss, "
+                        "churn, pull-fanout, rotate-probability, prune-"
+                        "stake-threshold, min-ingress-nodes, fail-nodes) "
+                        "lane-batched — K sweep points stacked on a vmapped "
+                        "lane axis run as ceil(K/lanes) compiled device "
+                        "programs with a single harvest each, bit-identical "
+                        "to the serial sweep (engine/lanes.py). 0 = serial. "
+                        "Shape-stepping sweeps (active-set-size, push-"
+                        "fanout) and origin-rank fall back to their "
+                        "existing paths")
     p.add_argument("--mesh-devices", type=int, default=0,
                    help="devices to shard origin batches over in "
                         "--all-origins mode (0 = all available)")
@@ -373,6 +409,8 @@ def config_from_args(args) -> Config:
             raise SystemExit("pull-interval must be >= 1")
     if args.mesh_node_shards < 1:
         raise SystemExit("mesh-node-shards must be >= 1")
+    if args.sweep_lanes < 0:
+        raise SystemExit("sweep-lanes must be >= 0")
     return Config(
         gossip_push_fanout=args.push_fanout,
         gossip_active_set_size=args.active_set_size,
@@ -409,6 +447,7 @@ def config_from_args(args) -> Config:
         num_synthetic_nodes=args.num_synthetic_nodes,
         all_origins=args.all_origins,
         origin_batch=args.origin_batch,
+        sweep_lanes=args.sweep_lanes,
         checkpoint_path=args.checkpoint_path,
         resume_path=args.resume_path,
         mesh_devices=args.mesh_devices,
@@ -647,30 +686,14 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
     import jax
     import jax.numpy as jnp
 
-    from .engine import (EngineParams, init_state, make_cluster_tables,
-                         run_rounds)
+    from .engine import init_state, make_cluster_tables, run_rounds
 
     reg = get_registry()
     _enable_compilation_cache(config)
     index = NodeIndex.from_stakes(accounts)
     stakes = dict(accounts)
     N = len(index)
-    params = EngineParams(
-        num_nodes=N,
-        push_fanout=config.gossip_push_fanout,
-        active_set_size=config.gossip_active_set_size,
-        probability_of_rotation=config.probability_of_rotation,
-        prune_stake_threshold=config.prune_stake_threshold,
-        min_ingress_nodes=config.min_ingress_nodes,
-        warm_up_rounds=config.warm_up_rounds,
-        fail_at=(config.when_to_fail
-                 if config.test_type == Testing.FAIL_NODES else -1),
-        fail_fraction=(config.fraction_to_fail
-                       if config.test_type == Testing.FAIL_NODES else 0.0),
-        trace_prune_cap=config.trace_prune_cap,
-        **_impair_params(config),
-        **_pull_params(config),
-    )
+    params = _engine_params(config, N)
     with reg.span("engine/tables"):
         tables = make_cluster_tables(index.stakes.astype(np.int64))
     reg.set_info("platform", jax.devices()[0].platform)
@@ -904,8 +927,7 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
     import jax
     import jax.numpy as jnp
 
-    from .engine import (EngineParams, init_state, make_cluster_tables,
-                         run_rounds)
+    from .engine import init_state, make_cluster_tables, run_rounds
 
     accounts, source_label = load_cluster_accounts(config, json_rpc_url)
     if config.checkpoint_path or config.resume_path:
@@ -930,18 +952,7 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
     log.info("##### BATCHED ORIGIN-RANK SWEEP: %s origins in one engine "
              "call #####", R)
 
-    params = EngineParams(
-        num_nodes=N,
-        push_fanout=config.gossip_push_fanout,
-        active_set_size=config.gossip_active_set_size,
-        probability_of_rotation=config.probability_of_rotation,
-        prune_stake_threshold=config.prune_stake_threshold,
-        min_ingress_nodes=config.min_ingress_nodes,
-        warm_up_rounds=config.warm_up_rounds,
-        trace_prune_cap=config.trace_prune_cap,
-        **_impair_params(config),
-        **_pull_params(config),
-    )
+    params = _engine_params(config, N)
     reg = get_registry()
     _enable_compilation_cache(config)
     with reg.span("engine/tables"):
@@ -1053,6 +1064,220 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         _feed_message_counters(stats_list[col], state, col, index)
         _finalize_sim_stats(configs[col], stats_list[col], stakes,
                             stats_collection, dp_queue, col, start_ts)
+
+
+def run_lane_sweep(config: Config, json_rpc_url: str, origin_ranks,
+                   stats_collection: GossipStatsCollection, dp_queue,
+                   start_ts: str):
+    """A traced-knob sweep as lane-batched device programs (ISSUE 6).
+
+    The serial sweep runs K simulations through one warm executable but
+    still pays K engine calls with a host harvest between them.  Here the
+    K sweep points' :class:`EngineKnobs` vectors stack onto a vmapped
+    **lane** axis (engine/lanes.py) and the whole sweep executes as
+    ``ceil(K / --sweep-lanes)`` batched calls — each one compiled program
+    covering init-to-finish of every lane, with a single ``[K, ...]``
+    device->host harvest.  Per-lane rows and final state are bit-identical
+    to the serial sweep (tests/test_sweep_compile.py, tools/lane_smoke.py),
+    and each lane feeds the SAME per-sim stats/report/Influx paths the
+    serial loop uses, in the same sweep order.
+
+    A lane batch that the sweep doesn't fill (K % lanes != 0) is padded by
+    repeating the last point's knobs; padded lanes are computed and then
+    dropped before any stats/Influx feeding, so they can never leak.
+
+    Like the batched origin-rank sweep, the cluster is loaded ONCE and
+    every sweep point runs against it (that is the point of a parameter
+    sweep).  File/RPC account sources give the serial loop the same
+    cluster per sim anyway; synthetic clusters advance the global pubkey
+    counter per load, so serial sims technically run on freshly-numbered
+    pubkeys — comparisons reset the counter per serial arm, exactly as
+    tests/test_cli.py does for the origin-rank batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from .checkpoint import guard_lane_checkpoint
+    from .engine import (broadcast_state, check_lane_knobs, init_state,
+                         lane_state, make_cluster_tables, merge_lane_statics,
+                         run_rounds_lanes, stack_knobs)
+    from .stats.aggregate import lane_rows
+
+    if config.trace_dir:
+        raise SystemExit(
+            "ERROR: --trace-dir is not supported with --sweep-lanes: the "
+            "flight recorder captures one sim's event stream per trace and "
+            "a lane batch runs K sims inside one device program. Drop "
+            "--sweep-lanes to trace a serial sweep (one trace per sim).")
+    guard_lane_checkpoint(config)
+
+    K = config.num_simulations
+    L = max(1, min(config.sweep_lanes, K))
+    n_batches = (K + L - 1) // L
+    sweep = [_stepped_sweep_config(config, i, origin_ranks)
+             for i in range(K)]
+
+    accounts, source_label = load_cluster_accounts(config, json_rpc_url)
+    if len(accounts) < config.origin_rank:
+        raise SystemExit(
+            f"ERROR: origin_rank larger than number of simulation nodes. "
+            f"nodes: {len(accounts)}, origin_rank: {config.origin_rank}")
+    origin = find_nth_largest_node(config.origin_rank, list(accounts.items()))
+    origin_pubkey = origin[0]
+    stakes = dict(accounts)
+    index = NodeIndex.from_stakes(accounts)
+    N = len(index)
+
+    params_list = [_engine_params(c, N).validate() for c, _ in sweep]
+    static = merge_lane_statics([p.static_part() for p in params_list])
+    knob_list = [p.knob_values() for p in params_list]
+    check_lane_knobs(static, knob_list)
+
+    reg = get_registry()
+    _enable_compilation_cache(config)
+    with reg.span("engine/tables"):
+        tables = make_cluster_tables(index.stakes.astype(np.int64))
+    reg.set_info("platform", jax.devices()[0].platform)
+    reg.set_info("origin_batch", 1)
+    reg.set_info("sweep_lanes", L)
+    reg.set_info("lane_batches", n_batches)
+    origin_idx = index.index_of(origin_pubkey)
+    origins = jnp.asarray([origin_idx], dtype=jnp.int32)
+
+    log.info("##### LANE-BATCHED SWEEP: %s sims x %s lanes = %s batched "
+             "engine call(s) #####", K, L, n_batches)
+    log.info("ORIGIN: %s", origin_pubkey)
+
+    # per-sweep-point stats, constructed exactly as run_simulation does so
+    # the collection the serial sweep builds and this one are identical
+    stats_list = []
+    for c, _ in sweep:
+        stats = GossipStats()
+        stats.set_simulation_parameters(c)
+        stats.set_origin(origin_pubkey)
+        stats.initialize_message_stats(stakes)
+        stats.build_validator_stake_distribution_histogram(
+            VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS, stakes)
+        stats_list.append(stats)
+
+    total = config.gossip_iterations
+    warm = min(config.warm_up_rounds, total)
+    measured = total - warm
+    if measured <= 0:
+        # unreachable via dispatch_sweeps (_lane_sweep_blocker routes this
+        # config class to the serial loop, which owns the degenerate
+        # behavior); kept as a guard for direct callers
+        log.warning("WARNING: no measured rounds (iterations <= warm-up-"
+                    "rounds); lane sweep has nothing to harvest")
+        return
+
+    log.info("Simulating Gossip and setting active sets. Please wait.....")
+    with reg.span("engine/init"):
+        base_state = init_state(jax.random.PRNGKey(config.seed), tables,
+                                origins, params_list[0])
+        jax.block_until_ready(base_state)
+    log.info("Simulation Complete!")
+
+    profile_cm = (jax.profiler.trace(config.jax_profile_dir)
+                  if config.jax_profile_dir else contextlib.nullcontext())
+    hb = Heartbeat(n_batches, label="lane sweep", unit="lane batch")
+    with profile_cm:
+        for b in range(n_batches):
+            ids = list(range(b * L, min((b + 1) * L, K)))
+            padded = ids + [ids[-1]] * (L - len(ids))
+            kstack = stack_knobs([knob_list[i] for i in padded])
+            states = broadcast_state(base_state, L)
+            t_blk = time.perf_counter()
+            # batch 1 carries the (single) compile; batches 2.. are pure
+            # warm execution and feed the throughput denominators
+            cm, counted = _engine_call_span(reg)
+            with cm:
+                states, rows = run_rounds_lanes(static, tables, origins,
+                                                states, kstack, total,
+                                                detail=True)
+                rows = jax.tree_util.tree_map(np.asarray, rows)
+            blk_wall = time.perf_counter() - t_blk
+            if counted:
+                reg.add("origin_iters", len(ids) * measured)
+                reg.add("messages_delivered",
+                        int(rows["delivered"][warm:, :len(ids)].sum()))
+            with reg.span("stats/harvest"):
+                for pos, i in enumerate(ids):
+                    _harvest_lane(config, sweep[i], stats_list[i],
+                                  lane_rows(rows, pos), lane_state(states,
+                                                                   pos),
+                                  params_list[i], index, stakes,
+                                  origin_pubkey, dp_queue, i, start_ts,
+                                  warm, total, len(accounts), source_label)
+                    _finalize_sim_stats(sweep[i][0], stats_list[i], stakes,
+                                        stats_collection, dp_queue, i,
+                                        start_ts)
+            _push_sim_perf_point(dp_queue, ids[0], start_ts, blk_wall,
+                                 measured, len(ids))
+            hb.beat(b + 1)
+    hb.finish()
+
+
+def _harvest_lane(config, sweep_point, stats, lrows, lane_st, params, index,
+                  stakes, origin_pubkey, dp_queue, sim_iter, start_ts,
+                  warm, total, num_accounts, source_label):
+    """Feed one harvested lane through the serial per-sim paths: the
+    Influx preamble run_simulation emits, the warm-up cadence, every
+    measured round via _feed_measured_round, and the end-of-run counters.
+    ``lrows`` leaves are [total, O] (the full run, warm-up included);
+    only rounds >= ``warm`` feed statistics, like the serial blocks."""
+    c, start_value = sweep_point
+    log.info("##### SIMULATION ITERATION: %s #####", sim_iter)
+    if sim_iter == 0 and dp_queue is not None:
+        dp = InfluxDataPoint(start_ts, 0)
+        start = ("N/A" if c.test_type == Testing.NO_TEST
+                 else str(start_value))
+        dp.create_test_type_point(
+            config.num_simulations, config.gossip_iterations,
+            config.warm_up_rounds, config.step_size, num_accounts,
+            config.probability_of_rotation, source_label, start,
+            config.test_type)
+        dp.create_validator_stake_distribution_histogram_point(
+            stats.get_validator_stake_distribution_histogram())
+        dp_queue.push_back(dp)
+    if dp_queue is not None:
+        dp = InfluxDataPoint(start_ts, sim_iter)
+        dp.set_start()
+        dp_queue.push_back(dp)
+
+    # warm-up cadence (progress log + config point every 10 rounds), as
+    # the serial TPU path emits before its warm-up scan
+    for it in range(0, warm, 10):
+        log.info("GOSSIP ITERATION: %s", it)
+        _push_config_point(c, dp_queue, sim_iter, start_ts)
+    if c.heal_at >= 0 and c.heal_at < warm:
+        # heal inside warm-up: the recovery metric still sees every
+        # post-heal round (iteration-exact, like the serial paths)
+        cov_w = lrows["coverage"][:warm, 0]
+        for it in range(c.heal_at, warm):
+            stats.note_post_heal_coverage(it, float(cov_w[it]))
+
+    _warn_shape_truncation(_lane_rows_measured(lrows, warm), params)
+    for it in range(warm, total):
+        if it % 10 == 0:
+            log.info("GOSSIP ITERATION: %s", it)
+            _push_config_point(c, dp_queue, sim_iter, start_ts)
+        _feed_measured_round(stats, lrows, it, 0, it, c, index, stakes,
+                             origin_pubkey, dp_queue, sim_iter, start_ts)
+
+    if params.fail_at >= 0 or params.has_churn:
+        # one-shot fail masks never change after fail_at and churn is
+        # reported at end-of-run, so the final lane state carries exactly
+        # what the serial path records
+        failed_idx = np.nonzero(np.asarray(lane_st.failed)[0])[0]
+        stats.set_failed_nodes({index.pubkeys[j] for j in failed_idx})
+    _feed_message_counters(stats, lane_st, 0, index)
+
+
+def _lane_rows_measured(lrows, warm):
+    """The measured-round slice of a lane's full-run rows (the view the
+    truncation warnings should see — warm-up truncation is counted by the
+    serial path's warm scan rows too, but its rows are discarded there)."""
+    return {k: v[warm:] for k, v in lrows.items()}
 
 
 def _trace_replay_origins(config: Config, params, tables, index,
@@ -1650,6 +1875,91 @@ def _write_run_report(config, stats=None, faults=None, influx=None):
 # sweep dispatch (gossip_main.rs:774-951)
 # --------------------------------------------------------------------------
 
+def _stepped_sweep_config(config: Config, i: int, origin_ranks):
+    """Sweep point ``i``'s (stepped config, influx start value) — the
+    reference's per-sim stepping (gossip_main.rs:774-951), shared by the
+    serial loop and the lane-batched path so the two can never step a
+    sweep differently."""
+    tt = config.test_type
+    if tt == Testing.ACTIVE_SET_SIZE:
+        v = config.gossip_active_set_size + i * config.step_size.as_int()
+        return config.stepped(gossip_active_set_size=v), \
+            float(config.gossip_active_set_size)
+    if tt == Testing.PUSH_FANOUT:
+        v = config.gossip_push_fanout + i * config.step_size.as_int()
+        c = config.stepped(gossip_push_fanout=v)
+        # fanout beyond the active set would silently cap (gossip_main.rs:812)
+        if v > c.gossip_active_set_size:
+            c = c.stepped(gossip_active_set_size=v)
+        return c, float(config.gossip_push_fanout)
+    if tt == Testing.MIN_INGRESS_NODES:
+        v = config.min_ingress_nodes + i * config.step_size.as_int()
+        # reference reports the stepped value here
+        return config.stepped(min_ingress_nodes=v), float(v)
+    if tt == Testing.PRUNE_STAKE_THRESHOLD:
+        v = config.prune_stake_threshold + i * config.step_size.as_float()
+        return config.stepped(prune_stake_threshold=v), \
+            float(config.prune_stake_threshold)
+    if tt == Testing.ORIGIN_RANK:
+        return config.stepped(origin_rank=origin_ranks[i]), \
+            float(origin_ranks[i])
+    if tt == Testing.FAIL_NODES:
+        v = config.fraction_to_fail + i * config.step_size.as_float()
+        return config.stepped(fraction_to_fail=v), \
+            float(config.fraction_to_fail)
+    if tt == Testing.ROTATE_PROBABILITY:
+        v = config.probability_of_rotation + i * config.step_size.as_float()
+        return config.stepped(probability_of_rotation=v), \
+            float(config.probability_of_rotation)
+    if tt == Testing.PACKET_LOSS:
+        v = min(config.packet_loss_rate
+                + i * config.step_size.as_float(), 1.0)
+        return config.stepped(packet_loss_rate=v), \
+            float(config.packet_loss_rate)
+    if tt == Testing.CHURN:
+        # sweep the fail rate; the recover rate rides along unstepped so
+        # each point probes a different steady-state failed fraction
+        v = min(config.churn_fail_rate
+                + i * config.step_size.as_float(), 1.0)
+        return config.stepped(churn_fail_rate=v), \
+            float(config.churn_fail_rate)
+    if tt == Testing.PULL_FANOUT:
+        # pull_fanout is a traced EngineKnobs field: steps within the
+        # static pull_slots width (auto: 8) reuse one compiled
+        # executable (PR 4 invariant, tests/test_pull.py)
+        v = config.pull_fanout + i * config.step_size.as_int()
+        return config.stepped(pull_fanout=v), float(config.pull_fanout)
+    return config, 0.0  # NO_TEST
+
+
+#: test types whose stepped Config field maps to a traced EngineKnobs leaf
+#: — the lane-eligible sweeps (ISSUE 6).  ACTIVE_SET_SIZE / PUSH_FANOUT
+#: step the static compile geometry and ORIGIN_RANK has its own batched
+#: path, so they stay serial.
+LANE_SWEEP_TYPES = (Testing.MIN_INGRESS_NODES, Testing.PRUNE_STAKE_THRESHOLD,
+                    Testing.FAIL_NODES, Testing.ROTATE_PROBABILITY,
+                    Testing.PACKET_LOSS, Testing.CHURN, Testing.PULL_FANOUT)
+
+
+def _lane_sweep_blocker(config: Config):
+    """None when --sweep-lanes can serve this sweep, else the reason the
+    dispatcher logs before falling back to the serial loop."""
+    if config.backend != "tpu":
+        return "lane mode requires --backend tpu"
+    if config.num_simulations < 2:
+        return "nothing to batch (num_simulations < 2)"
+    if config.test_type not in LANE_SWEEP_TYPES:
+        return (f"--test-type {config.test_type.value} does not step a "
+                f"traced engine knob; lane-eligible sweeps: "
+                + ", ".join(t.value for t in LANE_SWEEP_TYPES))
+    if config.gossip_iterations <= config.warm_up_rounds:
+        # nothing measurable to batch; the serial loop keeps its exact
+        # degenerate-case behavior (preamble Influx points, warm-up-only
+        # runs, post-heal coverage) instead of a lane approximation of it
+        return "no measured rounds (iterations <= warm-up-rounds)"
+    return None
+
+
 def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
                     collection: GossipStatsCollection, dp_queue,
                     start_ts: str):
@@ -1661,60 +1971,19 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
         run_origin_rank_sweep(config, json_rpc_url, origin_ranks,
                               collection, dp_queue, start_ts)
         return
+    if config.sweep_lanes > 0:
+        blocker = _lane_sweep_blocker(config)
+        if blocker is None:
+            # traced-knob sweep: the K points ride a vmapped lane axis as
+            # ceil(K/lanes) batched device programs (engine/lanes.py)
+            run_lane_sweep(config, json_rpc_url, origin_ranks, collection,
+                           dp_queue, start_ts)
+            return
+        log.warning("WARNING: --sweep-lanes %s ignored (%s); running the "
+                    "serial sweep", config.sweep_lanes, blocker)
     hb = Heartbeat(config.num_simulations, label="sweep", unit="simulation")
     for i in range(config.num_simulations):
-        if tt == Testing.ACTIVE_SET_SIZE:
-            v = config.gossip_active_set_size + i * config.step_size.as_int()
-            c = config.stepped(gossip_active_set_size=v)
-            start = float(config.gossip_active_set_size)
-        elif tt == Testing.PUSH_FANOUT:
-            v = config.gossip_push_fanout + i * config.step_size.as_int()
-            c = config.stepped(gossip_push_fanout=v)
-            # fanout beyond the active set would silently cap (gossip_main.rs:812)
-            if v > c.gossip_active_set_size:
-                c = c.stepped(gossip_active_set_size=v)
-            start = float(config.gossip_push_fanout)
-        elif tt == Testing.MIN_INGRESS_NODES:
-            v = config.min_ingress_nodes + i * config.step_size.as_int()
-            c = config.stepped(min_ingress_nodes=v)
-            start = float(v)  # reference reports the stepped value here
-        elif tt == Testing.PRUNE_STAKE_THRESHOLD:
-            v = config.prune_stake_threshold + i * config.step_size.as_float()
-            c = config.stepped(prune_stake_threshold=v)
-            start = float(config.prune_stake_threshold)
-        elif tt == Testing.ORIGIN_RANK:
-            c = config.stepped(origin_rank=origin_ranks[i])
-            start = float(origin_ranks[i])
-        elif tt == Testing.FAIL_NODES:
-            v = config.fraction_to_fail + i * config.step_size.as_float()
-            c = config.stepped(fraction_to_fail=v)
-            start = float(config.fraction_to_fail)
-        elif tt == Testing.ROTATE_PROBABILITY:
-            v = (config.probability_of_rotation
-                 + i * config.step_size.as_float())
-            c = config.stepped(probability_of_rotation=v)
-            start = float(config.probability_of_rotation)
-        elif tt == Testing.PACKET_LOSS:
-            v = min(config.packet_loss_rate
-                    + i * config.step_size.as_float(), 1.0)
-            c = config.stepped(packet_loss_rate=v)
-            start = float(config.packet_loss_rate)
-        elif tt == Testing.CHURN:
-            # sweep the fail rate; the recover rate rides along unstepped so
-            # each point probes a different steady-state failed fraction
-            v = min(config.churn_fail_rate
-                    + i * config.step_size.as_float(), 1.0)
-            c = config.stepped(churn_fail_rate=v)
-            start = float(config.churn_fail_rate)
-        elif tt == Testing.PULL_FANOUT:
-            # pull_fanout is a traced EngineKnobs field: steps within the
-            # static pull_slots width (auto: 8) reuse one compiled
-            # executable (PR 4 invariant, tests/test_pull.py)
-            v = config.pull_fanout + i * config.step_size.as_int()
-            c = config.stepped(pull_fanout=v)
-            start = float(config.pull_fanout)
-        else:  # NO_TEST
-            c, start = config, 0.0
+        c, start = _stepped_sweep_config(config, i, origin_ranks)
         if config.trace_dir and config.num_simulations > 1:
             # one flight-recorder directory per swept simulation; each
             # holds its own manifest + segments

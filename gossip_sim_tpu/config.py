@@ -122,6 +122,13 @@ class Config:
     num_synthetic_nodes: int = 0    # >0: synthetic cluster instead of file/RPC
     all_origins: bool = False       # vmap the origin axis (north-star mode)
     origin_batch: int = 0           # origins per device batch (0 = auto)
+    sweep_lanes: int = 0            # >0: run knob sweeps lane-batched — K
+                                    # sweep points vmapped into one device
+                                    # program, ceil(K/lanes) batched calls
+                                    # (engine/lanes.py); 0 = serial sweep.
+                                    # Only traced-knob test types are
+                                    # lane-eligible (cli.LANE_SWEEP_TYPES);
+                                    # others warn and run serially
     checkpoint_path: str = ""       # save sim state (periodically + at end)
     resume_path: str = ""           # load sim state and continue
     mesh_devices: int = 0           # 0 = all available devices

@@ -17,7 +17,12 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from .params import EngineKnobs, EngineParams, EngineStatic  # noqa: E402
+from .params import (  # noqa: E402
+    EngineKnobs,
+    EngineParams,
+    EngineStatic,
+    merge_lane_statics,
+)
 from .sampler import SamplerTables, build_sampler_tables  # noqa: E402
 from .cache import (  # noqa: E402
     enable_persistent_cache,
@@ -34,11 +39,30 @@ from .core import (  # noqa: E402
     round_step,
     run_rounds,
 )
+from .lanes import (  # noqa: E402
+    broadcast_state,
+    check_lane_knobs,
+    clear_lane_cache,
+    lane_cache_size,
+    lane_state,
+    num_lanes,
+    run_rounds_lanes,
+    stack_knobs,
+)
 
 __all__ = [
     "EngineKnobs",
     "EngineParams",
     "EngineStatic",
+    "merge_lane_statics",
+    "broadcast_state",
+    "check_lane_knobs",
+    "clear_lane_cache",
+    "lane_cache_size",
+    "lane_state",
+    "num_lanes",
+    "run_rounds_lanes",
+    "stack_knobs",
     "SamplerTables",
     "build_sampler_tables",
     "ClusterTables",

@@ -399,7 +399,12 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
     which case ``knobs`` must carry the :class:`EngineKnobs` pytree of
     (possibly traced) scalars.  ``_run`` uses the second form so a sweep
     stepping any knob reuses one compiled executable; the two forms emit
-    bit-identical results for equal values.
+    bit-identical results for equal values.  The lane runner
+    (engine/lanes.py) additionally ``jax.vmap``s this function over a
+    leading (state, knobs) lane axis — safe because every batched control
+    structure here is lane-clean: the BFS while_loop body is a fixed
+    point for converged lanes and the lax.cond branches are pure, so a
+    lane inside a batch computes bit-identically to a serial call.
 
     ``trace`` additionally emits the flight-recorder event rows consumed by
     :mod:`gossip_sim_tpu.obs.trace` (candidate push slots with per-edge
@@ -1243,7 +1248,11 @@ def run_rounds(params, tables: ClusterTables, origins: jax.Array,
     impairment rates/windows, warm-up boundary, ...) compiles once and
     reuses the executable K times.  Every call records either
     ``engine/compiles`` or ``engine/cache_hits`` on the default span
-    registry (the recompile-count regression guard)."""
+    registry (the recompile-count regression guard).
+
+    The serial companion to this is :func:`engine.lanes.run_rounds_lanes`,
+    which stacks the K knob vectors of a sweep into a lane axis and runs
+    them as ONE batched device program instead of K calls through here."""
     static, kn = _split_params(params, knobs)
     before = compiled_cache_size()
     out = _run(static, tables, origins, state, kn, int(num_iters),

@@ -1,0 +1,153 @@
+"""Device-resident sweep lanes: the sweep axis as one batched program.
+
+PR 4 made a K-sim knob sweep compile once, but the K points still executed
+serially — one engine call per point, with a host round-trip between them,
+so the device idled while Python harvested.  Since every sweep knob is a
+traced :class:`EngineKnobs` leaf, the whole sweep folds onto the device
+instead: stack K knob vectors into a leading **lane** axis (each leaf
+``()`` -> ``[K]``), tile the initial :class:`SimState` the same way
+(``[O, ...]`` -> ``[K, O, ...]``), and ``jax.vmap`` :func:`round_step`
+over that axis inside one jitted ``lax.scan``.  A whole loss x churn x
+fanout grid then runs as ONE compiled executable with ONE harvest
+transfer — the overlap strategy of "The Algorithm of Pipelined Gossiping"
+(PAPERS.md) applied to parameter studies, and the same
+batch-many-propagations pattern GASim uses.
+
+Bit-exactness contract (tests/test_sweep_compile.py, tools/lane_smoke.py):
+a lane's rows and final state are bit-identical to a serial
+:func:`run_rounds` call with the same static key and that lane's knobs.
+This holds by construction, not luck:
+
+* every per-round reduction that crosses the node axis is integer
+  (histograms, counts, cumsums) or elementwise-float on integer inputs,
+  so batching cannot reorder a float accumulation;
+* the BFS ``lax.while_loop`` body is a fixed point once a lane's frontier
+  empties (all targets key as "no frontier source", so ``newly`` stays
+  all-False and ``dist``/``reached`` freeze) — under vmap the loop runs to
+  the slowest lane while converged lanes step as no-ops, which is exactly
+  the "per-lane early-exit becomes masking" rule a rectangular batched
+  scan needs;
+* ``lax.cond`` branches (fail event, prune capture, prune-apply budget)
+  are pure, so vmap's execute-both-and-select keeps per-lane selections
+  exact.
+
+The lane runner has its own jit cache (``_run_lanes``) but records into
+the same ``engine/compiles`` / ``engine/cache_hits`` registry counters as
+:func:`run_rounds`, so the run-report compile accounting covers lane-mode
+sweeps unchanged: one compile for the whole sweep, one cache hit per
+further lane batch.
+
+Flight-recorder ``trace`` rows are not offered here: per-lane trace
+segments would interleave K sims' event streams in one capture buffer,
+and the CLI forbids ``--trace-dir`` in lane mode with a clear error
+instead (ISSUE 6).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .core import (SimState, _check_knob_gates, _note_compile_accounting,
+                   round_step)
+from .params import EngineKnobs, EngineStatic
+
+
+def stack_knobs(knob_list) -> EngineKnobs:
+    """K per-lane :class:`EngineKnobs` -> one pytree of ``[K]`` leaves.
+
+    Each leaf keeps its fixed traced dtype (np.stack of same-dtype scalars
+    never promotes), so the stacked pytree presents one abstract value per
+    leaf — ``[K]`` of the contract dtype — to the jit cache regardless of
+    the concrete knob values."""
+    knob_list = list(knob_list)
+    if not knob_list:
+        raise ValueError("stack_knobs needs at least one lane")
+    return EngineKnobs(*(np.stack([getattr(k, f) for k in knob_list])
+                         for f in EngineKnobs._fields))
+
+
+def num_lanes(knobs: EngineKnobs) -> int:
+    """Lane count of a stacked knob pytree."""
+    return int(np.shape(knobs.impair_seed)[0])
+
+
+def broadcast_state(state: SimState, lanes: int) -> SimState:
+    """Tile one ``[O, ...]`` SimState across ``lanes`` identical lanes.
+
+    Lane-eligible sweeps share init geometry (init_state consumes only
+    static fields + the PRNG key), so every lane starts from the same
+    state a serial point would — tiling is bit-exact, and the K-1 extra
+    ``init_state`` calls of the serial sweep are simply skipped."""
+    return SimState(*(jnp.broadcast_to(x[None], (lanes,) + tuple(x.shape))
+                      for x in state))
+
+
+def lane_state(states: SimState, lane: int) -> SimState:
+    """One lane's ``[O, ...]`` SimState view out of a ``[K, O, ...]``
+    batch (the shape every serial consumer — checkpointing aside —
+    expects)."""
+    return SimState(*(x[lane] for x in states))
+
+
+def check_lane_knobs(static: EngineStatic, knob_list) -> None:
+    """Per-lane gate guard: every lane's knob vector must be servable by
+    the (unioned) static compile key — an active knob against a False
+    gate would silently simulate wrong physics (core._check_knob_gates)."""
+    for kn in knob_list:
+        _check_knob_gates(static, kn)
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6), donate_argnums=(3,))
+def _run_lanes(static, tables, origins, states, knobs, num_iters, detail,
+               start_it):
+    def step(st, it):
+        def one(s, k):
+            return round_step(static, tables, origins, s, it, detail=detail,
+                              knobs=k)
+        return jax.vmap(one)(st, knobs)
+    its = jnp.arange(num_iters) + start_it
+    return lax.scan(step, states, its)
+
+
+def lane_cache_size() -> int:
+    """Executables in the lane runner's jit cache (-1 if the running JAX
+    exposes no introspection) — the lane-mode arm of the recompile-count
+    regression guards."""
+    try:
+        return int(_run_lanes._cache_size())
+    except Exception:  # pragma: no cover - older/newer jax internals
+        return -1
+
+
+def clear_lane_cache() -> None:
+    """Drop every compiled lane executable (forces a fresh compile on the
+    next call)."""
+    try:
+        _run_lanes.clear_cache()
+    except Exception:  # pragma: no cover
+        pass
+
+
+def run_rounds_lanes(static: EngineStatic, tables, origins, states: SimState,
+                     knobs: EngineKnobs, num_iters: int, start_it=0,
+                     detail: bool = False):
+    """Run ``num_iters`` rounds of K lanes as one jitted scan.
+
+    ``states`` carries a leading lane axis (:func:`broadcast_state`);
+    ``knobs`` is a stacked pytree of ``[K]`` leaves (:func:`stack_knobs`).
+    Returns ``(states, rows)`` where every rows leaf has shape
+    ``[num_iters, K, ...]`` — slice a lane with
+    :func:`gossip_sim_tpu.stats.aggregate.lane_rows` to feed the serial
+    per-sim stats paths unchanged.  Records ``engine/compiles`` /
+    ``engine/cache_hits`` on the shared span registry exactly like
+    :func:`run_rounds`."""
+    before = lane_cache_size()
+    out = _run_lanes(static, tables, origins, states, knobs, int(num_iters),
+                     bool(detail), jnp.asarray(start_it, jnp.int32))
+    _note_compile_accounting(before, lane_cache_size())
+    return out

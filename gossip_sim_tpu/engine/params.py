@@ -157,6 +157,52 @@ class EngineStatic(NamedTuple):
         return _resolve_k_inbound(self.inbound_cap, self.push_fanout)
 
 
+def merge_lane_statics(statics) -> EngineStatic:
+    """The union compile key for a set of sweep lanes (engine/lanes.py).
+
+    A lane-batched sweep runs K knob vectors through ONE compiled
+    executable, so every lane must share one ``EngineStatic``.  Two kinds
+    of per-lane drift are reconcilable without changing any lane's bits:
+
+    * the coarse impairment gates (``has_fail``/``has_loss``/``has_churn``/
+      ``has_partition``) OR together — a gated block evaluated at its off
+      knob endpoint reduces exactly to the unimpaired graph (the PR-4
+      contract ``_check_knob_gates`` encodes), so e.g. a packet-loss sweep
+      starting at rate 0 runs its 0 lane through the loss-gated graph
+      bit-identically;
+    * ``pull_slots`` takes the max — slots beyond a lane's traced
+      ``pull_fanout`` are masked per slot, and the per-slot hash draws
+      depend only on (node, slot), so widening never perturbs a lane.
+
+    Any other field differing between lanes is a genuine shape/structure
+    divergence (one executable cannot serve both) and raises ``ValueError``
+    naming the fields, so callers fall back to the serial sweep loudly.
+    """
+    statics = list(statics)
+    if not statics:
+        raise ValueError("merge_lane_statics needs at least one lane")
+    merged = statics[0]._replace(
+        has_fail=any(s.has_fail for s in statics),
+        has_loss=any(s.has_loss for s in statics),
+        has_churn=any(s.has_churn for s in statics),
+        has_partition=any(s.has_partition for s in statics),
+        pull_slots=max(s.pull_slots for s in statics),
+    )
+    for s in statics:
+        norm = s._replace(has_fail=merged.has_fail, has_loss=merged.has_loss,
+                          has_churn=merged.has_churn,
+                          has_partition=merged.has_partition,
+                          pull_slots=merged.pull_slots)
+        if norm != merged:
+            diff = sorted(f for f in EngineStatic._fields
+                          if getattr(norm, f) != getattr(merged, f))
+            raise ValueError(
+                f"sweep lanes disagree on static compile-key field(s) "
+                f"{diff}; only traced-knob sweeps can share one lane-batched "
+                f"executable")
+    return merged
+
+
 class EngineParams(NamedTuple):
     """The full user-facing parameter set (static + dynamic, concrete)."""
 

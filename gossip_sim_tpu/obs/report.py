@@ -31,6 +31,12 @@ Compile-accounting counters (engine/core.py run_rounds; ISSUE 4):
 Both surface as flat top-level keys (``compiles``/``cache_hits``) so
 BENCH lines capture amortization, not just raw speed.
 
+Lane-mode sweeps (``--sweep-lanes``, ISSUE 6) additionally surface
+``sweep_lanes`` (lanes per batched engine call; 0 = serial sweep) and
+``lane_batches`` (batched calls the sweep took, ceil(K/lanes)) as flat
+top-level keys from registry info; a whole lane-mode sweep reads
+``compiles == 1`` with ``lane_batches - 1`` cache hits.
+
 Span-name conventions (shared by cli.py, bench.py, tools/):
 
 * ``ingest``          account source -> {pubkey: stake}
@@ -198,6 +204,9 @@ def build_run_report(config, registry, *, stats: dict | None = None,
 
     report = _flat_summary(registry, platform=platform, num_nodes=num_nodes,
                            origin_batch=origin_batch, iterations=iterations)
+    # lane-mode sweep accounting (engine/lanes.py; 0/0 = serial sweep)
+    report["sweep_lanes"] = int(info.get("sweep_lanes", 0))
+    report["lane_batches"] = int(info.get("lane_batches", 0))
     rounds_s = registry.get("engine/rounds")
     msgs = registry.counter("messages_delivered")
     wall = snap["wall_s"]

@@ -79,6 +79,22 @@ class DatapointQueue:
         with self._lock:
             return len(self._dq)
 
+    def drain_deterministic_lines(self) -> list:
+        """Drain the queue into its deterministic wire payload: every line
+        with the per-point ns timestamp (the trailing token) stripped and
+        the wall-clock-valued ``sim_perf`` series dropped.  This is THE
+        normalized form two runs of the same simulation must agree on —
+        the lane-sweep parity tests and tools/lane_smoke.py both diff it,
+        so the Influx bit-exactness contract has one definition."""
+        lines = []
+        while len(self):
+            dp = self.pop_front()
+            for ln in dp.data().splitlines():
+                if not ln or ln.startswith("sim_perf"):
+                    continue
+                lines.append(ln.rsplit(" ", 1)[0])
+        return lines
+
 
 class Tracker:
     """dequeued==sent drain tracker (influx_db.rs:100-144)."""

@@ -32,6 +32,20 @@ from .trackers import EgressIngressMessageTracker
 log = logging.getLogger(__name__)
 
 
+def lane_rows(rows: dict, lane: int) -> dict:
+    """One sweep lane's rows out of a lane-batched engine harvest.
+
+    ``run_rounds_lanes`` (engine/lanes.py) returns rows with a lane axis
+    after the iteration axis — every leaf is ``[iters, K, ...]`` where a
+    serial ``run_rounds`` harvest is ``[iters, ...]``.  Slicing one lane
+    restores exactly the serial shape, so the per-sim stats feeders
+    (cli._feed_measured_round and friends) consume a lane unchanged: the
+    lane-batched sweep and the serial sweep flow through one stats path
+    and can never drift.  Works on device arrays and the np.asarray'd
+    harvest alike."""
+    return {k: v[:, lane] for k, v in rows.items()}
+
+
 class HistogramHopsStat:
     """HopsStat (mean/median/max/min, zeros filtered) computed from binned
     counts instead of raw values (gossip_stats.rs:46-98 semantics)."""

@@ -117,6 +117,36 @@ class GossipStats:
     def set_origin(self, origin):
         self.origin = origin
 
+    def parity_snapshot(self) -> dict:
+        """Every deterministic per-sim series/counter as one dict — THE
+        bit-exactness surface two runs of the same simulation must agree
+        on.  Both the lane-sweep regression tests and the
+        tools/lane_smoke.py CI gate diff this snapshot, so the parity
+        contract has exactly one definition; extend it here when a new
+        stats field lands and every parity check picks it up."""
+        return {
+            "coverage": list(self.coverage_stats.collection),
+            "rmr": list(self.rmr_stats.collection),
+            "branching": list(self.outbound_branching_factors.collection),
+            "hops": list(self.hops_stats.raw_hop_collection),
+            "stranded": dict(self.stranded_node_collection.stranded_nodes),
+            "egress": dict(self.egress_messages.counts),
+            "ingress": dict(self.ingress_messages.counts),
+            "prunes": dict(self.prune_messages.counts),
+            "delivered": list(self.delivered_stats.collection),
+            "dropped": list(self.dropped_stats.collection),
+            "suppressed": list(self.suppressed_stats.collection),
+            "failed_count_series": list(self.failed_count_series),
+            "failed_nodes": set(self.failed_nodes),
+            "pull_requests": list(self.pull_requests_stats.collection),
+            "pull_responses": list(self.pull_responses_stats.collection),
+            "pull_misses": list(self.pull_misses_stats.collection),
+            "pull_dropped": list(self.pull_dropped_stats.collection),
+            "pull_suppressed": list(self.pull_suppressed_stats.collection),
+            "pull_rescued": list(self.pull_rescued_stats.collection),
+            "recovery_iterations": self.recovery_iterations,
+        }
+
     def initialize_message_stats(self, stakes):
         self.egress_messages.initialize_counts_map(stakes)
         self.ingress_messages.initialize_counts_map(stakes)

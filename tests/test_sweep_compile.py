@@ -1,4 +1,4 @@
-"""Compile-once sweep regression tests (ISSUE 4).
+"""Compile-once sweep regression tests (ISSUE 4) + sweep lanes (ISSUE 6).
 
 The EngineParams split (engine/params.py): numeric knobs are traced
 EngineKnobs scalars, so stepping any of them across a sweep reuses one
@@ -12,6 +12,13 @@ These tests pin the contract down:
 * shape knobs still recompile (the gates work both ways),
 * the persistent compilation cache round-trips executables through disk,
 * the CLI flag plumbs through.
+
+Sweep lanes (engine/lanes.py, ISSUE 6) extend the contract: K knob
+vectors stacked on a vmapped lane axis run as ONE batched device program,
+bit-identical per lane to serial runs — including a 1-lane batch vs the
+serial path, lanes whose convergence behavior differs wildly, and a lane
+count that doesn't divide the sweep (tail padding must never leak into
+stats or Influx).
 """
 
 import os
@@ -22,9 +29,11 @@ import numpy as np
 import pytest
 
 from gossip_sim_tpu.engine import (EngineKnobs, EngineParams, EngineStatic,
-                                   clear_compile_cache, compiled_cache_size,
-                                   init_state, make_cluster_tables,
-                                   run_rounds)
+                                   broadcast_state, clear_compile_cache,
+                                   clear_lane_cache, compiled_cache_size,
+                                   init_state, lane_cache_size, lane_state,
+                                   make_cluster_tables, merge_lane_statics,
+                                   run_rounds, run_rounds_lanes, stack_knobs)
 from gossip_sim_tpu.obs import get_registry
 
 
@@ -331,3 +340,282 @@ def test_round_step_static_requires_knobs():
     with pytest.raises(TypeError, match="knobs"):
         round_step(params.static_part(), tables, origins, state,
                    jnp.int32(0))
+
+
+# --------------------------------------------------------------------------
+# device-resident sweep lanes (engine/lanes.py, ISSUE 6)
+# --------------------------------------------------------------------------
+
+def _assert_rows_equal(a, b, msg=""):
+    assert set(a) == set(b), msg
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]),
+                                      err_msg=f"{msg}:{k}")
+
+
+def _assert_state_equal(a, b, msg=""):
+    for f in a._fields:
+        np.testing.assert_array_equal(np.asarray(getattr(a, f)),
+                                      np.asarray(getattr(b, f)),
+                                      err_msg=f"{msg}:{f}")
+
+
+class TestMergeLaneStatics:
+    def test_gate_union_and_pull_slots_max(self):
+        base = EngineParams(num_nodes=32)
+        statics = [
+            base.static_part(),
+            base._replace(packet_loss_rate=0.2).static_part(),
+            base._replace(churn_fail_rate=0.1).static_part(),
+            base._replace(gossip_mode="push").static_part(),
+        ]
+        merged = merge_lane_statics(statics)
+        assert merged.has_loss and merged.has_churn
+        assert not merged.has_fail and not merged.has_partition
+        pulls = [base._replace(gossip_mode="push-pull", pull_fanout=f)
+                 .static_part() for f in (2, 6, 12)]
+        assert merge_lane_statics(pulls).pull_slots == 12
+
+    def test_shape_divergence_raises(self):
+        base = EngineParams(num_nodes=32)
+        with pytest.raises(ValueError, match="push_fanout"):
+            merge_lane_statics([base.static_part(),
+                                base._replace(push_fanout=9).static_part()])
+        with pytest.raises(ValueError, match="gossip_mode"):
+            merge_lane_statics(
+                [base.static_part(),
+                 base._replace(gossip_mode="push-pull").static_part()])
+
+
+class TestSweepLanes:
+    N = 96
+    ROUNDS = 6
+
+    def _serial(self, static, knobs, tables, origins, rounds, seed=3):
+        """One serial reference run (own init, warm jit or not — results
+        are value-equal either way per the PR-4 contract)."""
+        params0 = EngineParams(num_nodes=self.N, warm_up_rounds=0)
+        state = init_state(jax.random.PRNGKey(seed), tables, origins,
+                           params0)
+        state, rows = run_rounds(static, tables, origins, state, rounds,
+                                 detail=True, knobs=knobs)
+        return (jax.tree_util.tree_map(np.asarray, state),
+                jax.tree_util.tree_map(np.asarray, rows))
+
+    def test_single_lane_bit_identical_to_serial(self):
+        """K=1: a lane batch of one is the serial path, bit for bit."""
+        tables = _cluster(self.N)
+        origins = jnp.arange(2, dtype=jnp.int32)
+        params = EngineParams(num_nodes=self.N, warm_up_rounds=0,
+                              packet_loss_rate=0.15, impair_seed=5)
+        static, kn = params.split()
+        base = init_state(jax.random.PRNGKey(3), tables, origins, params)
+        states, lrows = run_rounds_lanes(static, tables, origins,
+                                         broadcast_state(base, 1),
+                                         stack_knobs([kn]), self.ROUNDS,
+                                         detail=True)
+        lrows = jax.tree_util.tree_map(np.asarray, lrows)
+        s_state, s_rows = self._serial(static, kn, tables, origins,
+                                       self.ROUNDS)
+        _assert_rows_equal({k: v[:, 0] for k, v in lrows.items()}, s_rows,
+                           "K=1 rows")
+        _assert_state_equal(lane_state(states, 0), s_state, "K=1 state")
+
+    def test_divergent_convergence_lanes_match_serial(self):
+        """Lanes with wildly different convergence (lossless vs 60% loss
+        vs heavy churn) share one batched scan; the no-op masking of
+        converged lanes must keep every lane bit-identical to its serial
+        run — including lanes whose own static would gate the impairment
+        blocks out entirely."""
+        tables = _cluster(self.N)
+        origins = jnp.arange(1, dtype=jnp.int32)
+        base = EngineParams(num_nodes=self.N, warm_up_rounds=2,
+                            impair_seed=9)
+        lanes = [
+            base,                                     # clean, fast converge
+            base._replace(packet_loss_rate=0.6),      # heavy loss, slow
+            base._replace(churn_fail_rate=0.2,
+                          churn_recover_rate=0.05),   # churning
+            base._replace(packet_loss_rate=0.3,
+                          churn_fail_rate=0.05,
+                          churn_recover_rate=0.5),
+        ]
+        static = merge_lane_statics([p.static_part() for p in lanes])
+        knob_list = [p.knob_values() for p in lanes]
+        st0 = init_state(jax.random.PRNGKey(3), tables, origins, lanes[0])
+        states, lrows = run_rounds_lanes(static, tables, origins,
+                                         broadcast_state(st0, len(lanes)),
+                                         stack_knobs(knob_list),
+                                         self.ROUNDS, detail=True)
+        lrows = jax.tree_util.tree_map(np.asarray, lrows)
+        for i, kn in enumerate(knob_list):
+            s_state, s_rows = self._serial(static, kn, tables, origins,
+                                           self.ROUNDS)
+            _assert_rows_equal({k: v[:, i] for k, v in lrows.items()},
+                               s_rows, f"lane{i} rows")
+            _assert_state_equal(lane_state(states, i), s_state,
+                                f"lane{i} state")
+
+    def test_lane_batch_compiles_once(self):
+        tables = _cluster(self.N)
+        origins = jnp.arange(1, dtype=jnp.int32)
+        params = EngineParams(num_nodes=self.N, warm_up_rounds=0,
+                              packet_loss_rate=0.1)
+        static, _ = params.split()
+        knob_list = [params._replace(packet_loss_rate=0.1 * k).knob_values()
+                     for k in range(4)]
+        base = init_state(jax.random.PRNGKey(3), tables, origins, params)
+        reg = get_registry()
+        clear_lane_cache()
+        before = lane_cache_size()
+        c0 = reg.counter("engine/compiles")
+        h0 = reg.counter("engine/cache_hits")
+        for _ in range(3):   # 3 lane batches, one executable
+            run_rounds_lanes(static, tables, origins,
+                             broadcast_state(base, 4),
+                             stack_knobs(knob_list), 3)
+        assert lane_cache_size() - before == 1
+        assert reg.counter("engine/compiles") - c0 == 1
+        assert reg.counter("engine/cache_hits") - h0 == 2
+
+
+# --------------------------------------------------------------------------
+# --sweep-lanes CLI path (cli.run_lane_sweep)
+# --------------------------------------------------------------------------
+
+def _lane_cli_config(**kw):
+    from gossip_sim_tpu.config import Config, StepSize, Testing
+    base = dict(num_synthetic_nodes=64, gossip_iterations=7,
+                warm_up_rounds=3, test_type=Testing.PACKET_LOSS,
+                num_simulations=5, step_size=StepSize.parse("0.1"),
+                packet_loss_rate=0.0, seed=13)
+    base.update(kw)
+    return Config(**base)
+
+
+def _run_lane_dispatch(config, ranks=(1,)):
+    from gossip_sim_tpu.cli import dispatch_sweeps
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    from gossip_sim_tpu.sinks import DatapointQueue
+    from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+    reset_unique_pubkeys()
+    get_registry().reset()
+    clear_compile_cache()
+    clear_lane_cache()
+    coll = GossipStatsCollection()
+    coll.set_number_of_simulations(config.num_simulations)
+    dpq = DatapointQueue()
+    dispatch_sweeps(config, "", list(ranks), coll, dpq, "0")
+    return coll, dpq.drain_deterministic_lines()
+
+
+def _run_serial_reference(config, ranks=(1,)):
+    """The serial arm of the lane contract: each sweep point as its own
+    run_simulation against an identical cluster (counter reset per sim,
+    the methodology test_origin_rank_sweep_batched_matches_serial set)."""
+    from gossip_sim_tpu.cli import _stepped_sweep_config, run_simulation
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    from gossip_sim_tpu.sinks import DatapointQueue
+    from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+    coll = GossipStatsCollection()
+    coll.set_number_of_simulations(config.num_simulations)
+    dpq = DatapointQueue()
+    for i in range(config.num_simulations):
+        reset_unique_pubkeys()
+        c, start = _stepped_sweep_config(config, i, list(ranks))
+        run_simulation(c, "", coll, dpq, i, "0", start)
+    return coll, dpq.drain_deterministic_lines()
+
+
+def _assert_collections_equal(serial, lane):
+    """Per-sim bit-exactness via the one canonical parity surface
+    (GossipStats.parity_snapshot — shared with tools/lane_smoke.py)."""
+    assert len(serial.collection) == len(lane.collection)
+    for i, (a, b) in enumerate(zip(serial.collection, lane.collection)):
+        sa, sb = a.parity_snapshot(), b.parity_snapshot()
+        for key in sa:
+            assert sa[key] == sb[key], f"sim{i}:{key}"
+
+
+def test_lane_sweep_tail_padding_never_leaks():
+    """5 sims through 2 lanes = 3 batches, the last one half-padded: the
+    padded lane's rows must never reach stats or Influx, every sim's
+    stats must be bit-identical to its serial run, and the whole sweep
+    must compile exactly one executable."""
+    serial_coll, serial_pts = _run_serial_reference(_lane_cli_config())
+    lane_coll, lane_pts = _run_lane_dispatch(_lane_cli_config(sweep_lanes=2))
+    assert len(lane_coll.collection) == 5
+    _assert_collections_equal(serial_coll, lane_coll)
+    assert get_registry().counter("engine/compiles") == 1
+    assert serial_pts == lane_pts
+    # nothing in the wire payload mentions a sixth (padded) simulation
+    assert not any("simulation_iter=5" in ln for ln in lane_pts)
+
+
+def test_lane_sweep_influx_and_stats_parity_churn_and_pull():
+    """The acceptance sweeps beyond packet loss: churn and pull-fanout
+    lane sweeps produce bit-identical per-sim stats and Influx payloads
+    to the serial compile-once sweep."""
+    from gossip_sim_tpu.config import StepSize, Testing
+    for kw in (dict(test_type=Testing.CHURN,
+                    step_size=StepSize.parse("0.05"),
+                    churn_fail_rate=0.0, churn_recover_rate=0.3,
+                    num_simulations=3),
+               dict(test_type=Testing.PULL_FANOUT,
+                    step_size=StepSize.parse("2"),
+                    gossip_mode="push-pull", pull_fanout=1,
+                    num_simulations=3)):
+        serial_coll, serial_pts = _run_serial_reference(_lane_cli_config(**kw))
+        lane_coll, lane_pts = _run_lane_dispatch(
+            _lane_cli_config(sweep_lanes=3, **kw))
+        _assert_collections_equal(serial_coll, lane_coll)
+        assert serial_pts == lane_pts
+        assert get_registry().counter("engine/compiles") == 1
+
+
+def test_lane_sweep_rejects_trace_and_checkpoint():
+    with pytest.raises(SystemExit, match="trace-dir"):
+        _run_lane_dispatch(_lane_cli_config(sweep_lanes=2,
+                                            trace_dir="/tmp/nope"))
+    with pytest.raises(SystemExit, match="checkpoint"):
+        _run_lane_dispatch(_lane_cli_config(sweep_lanes=2,
+                                            checkpoint_path="/tmp/nope.npz"))
+
+
+def test_lane_sweep_falls_back_serially_for_shape_sweeps(caplog):
+    """A static-shape sweep (push-fanout) with --sweep-lanes warns and
+    runs the serial loop instead of erroring out."""
+    import logging
+    from gossip_sim_tpu.config import StepSize, Testing
+    cfg = _lane_cli_config(test_type=Testing.PUSH_FANOUT,
+                           step_size=StepSize.parse("1"),
+                           num_simulations=2, sweep_lanes=2,
+                           gossip_iterations=5, warm_up_rounds=3)
+    with caplog.at_level(logging.WARNING):
+        coll, _ = _run_lane_dispatch(cfg)
+    assert len(coll.collection) == 2
+    assert any("--sweep-lanes" in r.message for r in caplog.records)
+
+
+def test_lane_sweep_no_measured_rounds_falls_back_serially(caplog):
+    """iterations <= warm-up-rounds has nothing to lane-batch; the serial
+    loop owns the degenerate behavior (preamble Influx points, warm-up-
+    only sims), so the dispatcher must route there, not approximate it."""
+    import logging
+    cfg = _lane_cli_config(sweep_lanes=2, gossip_iterations=3,
+                           warm_up_rounds=3, num_simulations=2)
+    with caplog.at_level(logging.WARNING):
+        coll, pts = _run_lane_dispatch(cfg)
+    assert coll.is_empty()
+    assert any("no measured rounds" in r.message for r in caplog.records)
+    # the serial degenerate path still emits its per-sim Influx preamble
+    assert any(ln.startswith("simulation_config") for ln in pts)
+
+
+def test_cli_sweep_lanes_flag_plumbs_through():
+    from gossip_sim_tpu.cli import build_parser, config_from_args
+    args = build_parser().parse_args(["--sweep-lanes", "8"])
+    assert config_from_args(args).sweep_lanes == 8
+    assert config_from_args(build_parser().parse_args([])).sweep_lanes == 0
+    with pytest.raises(SystemExit):
+        config_from_args(build_parser().parse_args(["--sweep-lanes", "-1"]))

@@ -7,6 +7,8 @@ The gates, in dependency-light-first order:
   trace_smoke   flight-recorder schema/parity/overhead
   sweep_smoke   compile-once sweeps (1 compile across a knob sweep)
   pull_smoke    pull-gossip subsystem (healing, zero bit-impact, parity)
+  lane_smoke    device-resident sweep lanes (bit-exact vs serial, 1
+                compile, wall-clock < serial)
 
 Usage: python tools/ci_gates.py [--only NAME[,NAME...]]
 
@@ -21,7 +23,7 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
-         "pull_smoke"]
+         "pull_smoke", "lane_smoke"]
 
 
 def main() -> int:

@@ -1,0 +1,151 @@
+"""Lane-sweep smoke test: the device-resident sweep-lane contract as a CI
+gate (ISSUE 6).
+
+A 3-point packet-loss sweep dispatched at ``--sweep-lanes 3`` — the whole
+sweep as ONE batched engine program (engine/lanes.py) — against the serial
+sweep as the reference arm, asserting:
+
+  1. **bit-exactness** — every sweep point's per-sim statistics
+     (coverage/RMR/hops/stranded/message counters) and its deterministic
+     Influx wire payload are identical between the lane-batched and the
+     serial dispatch.  The serial arm runs each point as its own
+     run_simulation against an identical cluster (pubkey counter reset per
+     sim — the methodology the batched origin-rank sweep's test
+     established);
+  2. **one compile total** — the lane arm builds exactly one engine
+     executable for the whole sweep (``engine/compiles == 1``), where the
+     serial arm compiles the warm-up-scan and measured-block shapes
+     separately;
+  3. **wall-clock win** — the lane dispatch completes faster end-to-end
+     than the serial dispatch (it amortizes one compile, one init and one
+     harvest across the K points; on accelerators the win is the point of
+     the feature, on CPU it comes from the saved compile + init).
+
+Usage: python tools/lane_smoke.py [--num-nodes 1000] [--steps 3]
+       [--iterations 10] [--warm-up 4] [--seed 7] [--loss-start 0.05]
+       [--loss-step 0.05]
+
+Exit code 0 = all assertions hold; 1 = the lane contract broke.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="device-resident sweep-lane CI gate (CPU, <3 min)")
+    ap.add_argument("--num-nodes", type=int, default=1000)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--iterations", type=int, default=10)
+    ap.add_argument("--warm-up", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--loss-start", type=float, default=0.05)
+    ap.add_argument("--loss-step", type=float, default=0.05)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from gossip_sim_tpu.cli import (_stepped_sweep_config, dispatch_sweeps,
+                                    run_simulation)
+    from gossip_sim_tpu.config import Config, StepSize, Testing
+    from gossip_sim_tpu.engine import clear_compile_cache, clear_lane_cache
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    from gossip_sim_tpu.obs import get_registry
+    from gossip_sim_tpu.sinks import DatapointQueue
+    from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+
+    t0 = time.time()
+    K = args.steps
+
+    def config(**kw):
+        return Config(num_synthetic_nodes=args.num_nodes,
+                      gossip_iterations=args.iterations,
+                      warm_up_rounds=args.warm_up,
+                      test_type=Testing.PACKET_LOSS, num_simulations=K,
+                      step_size=StepSize.parse(str(args.loss_step)),
+                      packet_loss_rate=args.loss_start, seed=args.seed,
+                      **kw)
+
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    print(f"lane smoke: n={args.num_nodes} K={K} loss="
+          f"{[round(args.loss_start + k * args.loss_step, 4) for k in range(K)]} "
+          f"iters={args.iterations} (warm {args.warm_up})")
+
+    # ---- serial reference arm: K points, identical cluster each --------
+    reset_unique_pubkeys()
+    get_registry().reset()
+    clear_compile_cache()
+    clear_lane_cache()
+    cfg_s = config()
+    coll_s = GossipStatsCollection()
+    coll_s.set_number_of_simulations(K)
+    dpq_s = DatapointQueue()
+    t_serial = time.perf_counter()
+    for i in range(K):
+        reset_unique_pubkeys()
+        c, start = _stepped_sweep_config(cfg_s, i, [1])
+        run_simulation(c, "", coll_s, dpq_s, i, "0", start)
+    t_serial = time.perf_counter() - t_serial
+    pts_s = dpq_s.drain_deterministic_lines()
+
+    # ---- lane arm: the whole sweep as one batched program --------------
+    reset_unique_pubkeys()
+    get_registry().reset()
+    clear_compile_cache()
+    clear_lane_cache()
+    coll_l = GossipStatsCollection()
+    coll_l.set_number_of_simulations(K)
+    dpq_l = DatapointQueue()
+    t_lane = time.perf_counter()
+    dispatch_sweeps(config(sweep_lanes=K), "", [1], coll_l, dpq_l, "0")
+    t_lane = time.perf_counter() - t_lane
+    pts_l = dpq_l.drain_deterministic_lines()
+    lane_compiles = int(get_registry().counter("engine/compiles"))
+
+    print(f"  serial wall: {t_serial:.1f}s  lane wall: {t_lane:.1f}s")
+
+    check(len(coll_l.collection) == K,
+          f"lane sweep produced {K} per-sim stats "
+          f"(got {len(coll_l.collection)})")
+    # one canonical parity surface, shared with tests/test_sweep_compile
+    mismatched = []
+    for i, (a, b) in enumerate(zip(coll_s.collection, coll_l.collection)):
+        sa, sb = a.parity_snapshot(), b.parity_snapshot()
+        mismatched += [f"sim{i}:{k}" for k in sa if sa[k] != sb[k]]
+    check(not mismatched,
+          "per-sim stats bit-identical to the serial sweep"
+          + (f" (diverged: {mismatched})" if mismatched else ""))
+    check(pts_s == pts_l,
+          f"Influx wire payload identical ({len(pts_l)} deterministic "
+          f"points)" + ("" if pts_s == pts_l else
+                        f" — serial {len(pts_s)} vs lane {len(pts_l)}"))
+    check(lane_compiles == 1,
+          f"one engine compile for the whole lane sweep "
+          f"(got {lane_compiles})")
+    check(t_lane < t_serial,
+          f"lane dispatch faster than serial "
+          f"({t_lane:.1f}s vs {t_serial:.1f}s)")
+
+    dt = time.time() - t0
+    print(f"  elapsed: {dt:.1f}s")
+    if failures:
+        print(f"LANE SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("LANE SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
