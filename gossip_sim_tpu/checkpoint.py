@@ -22,7 +22,7 @@ from .engine.params import EngineParams
 
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 4
+_FORMAT_VERSION = 5
 # v1 checkpoints predate the tfail/rc_shi/rc_slo SimState fields; all three
 # are derivable from active/failed/rc_src plus the cluster stake table, so
 # v1 files remain loadable when ``tables`` is passed to restore_sim_state.
@@ -36,8 +36,14 @@ _FORMAT_VERSION = 4
 # subsystem (pull.py): the ``pull_hops_hist_acc``/``pull_rescued_acc``
 # accumulators and a ``pull`` meta block; pre-v4 files were written by the
 # push-only engine, so both accumulators backfill as zeros (exact — no
-# pull rounds ever ran) and the pull block as mode "push".
-_READABLE_VERSIONS = (1, 2, 3, 4)
+# pull rounds ever ran) and the pull block as mode "push".  v5 adds the
+# run-journal layer (resilience.py): a ``resilience`` meta block naming
+# the sibling journal file and the committed-unit count at save time, so
+# a resumed run can cross-check the state npz against the journal.  No
+# new arrays — pre-v5 files backfill an empty block and stay loadable;
+# the committed v1-v4 fixtures in tests/fixtures/checkpoints pin that
+# forward-compat contract forever (tests/test_checkpoint.py).
+_READABLE_VERSIONS = (1, 2, 3, 4, 5)
 
 # EngineParams fields that define array shapes; a mismatch makes the stored
 # state unusable under the new compile geometry.
@@ -60,27 +66,8 @@ _PULL_FIELDS = ("gossip_mode", "pull_fanout", "pull_interval",
 _PULL_DEFAULTS = {f: EngineParams._field_defaults[f] for f in _PULL_FIELDS}
 
 
-def guard_lane_checkpoint(config) -> None:
-    """No mid-sweep checkpoint in lane mode (ISSUE 6, explicit guard).
-
-    A lane-batched sweep evolves K sims inside one ``[K, O, ...]`` device
-    state and runs the whole simulation as a single scan — there is no
-    per-sim iteration boundary to checkpoint at, and a resumed lane batch
-    would need every lane's knobs and the exact lane packing to be
-    restored together.  Until a lane-aware checkpoint format exists, the
-    combination is rejected up front rather than silently writing a
-    checkpoint only the first lane could ever resume from."""
-    if getattr(config, "checkpoint_path", "") or getattr(config,
-                                                         "resume_path", ""):
-        raise SystemExit(
-            "ERROR: --checkpoint-path/--resume are not supported with "
-            "--sweep-lanes (no mid-sweep checkpoint in lane mode): a lane "
-            "batch runs the whole K-sim sweep inside one device program. "
-            "Drop --sweep-lanes to checkpoint/resume a serial sweep.")
-
-
 def save_state(path: str, state, params, config=None,
-               iteration: int = 0) -> None:
+               iteration: int = 0, resilience: dict | None = None) -> None:
     """Write SimState + EngineParams (+ optional Config) to one .npz.
 
     ``iteration`` records how many gossip rounds produced this state; a
@@ -96,6 +83,9 @@ def save_state(path: str, state, params, config=None,
                    for f in _IMPAIR_FIELDS},
         "pull": {f: pdict.get(f, _PULL_DEFAULTS[f]) for f in _PULL_FIELDS},
         "iteration": int(iteration),
+        # v5: journal cross-reference (resilience.py) — {} for plain
+        # single-run checkpoints with no journal alongside
+        "resilience": dict(resilience or {}),
     }
     if config is not None:
         cfg = dict(vars(config))
@@ -137,9 +127,10 @@ def load_state(path: str, params=None):
                   if k.startswith("state.")}
     stored = meta["params"]
     # pre-v3 backfill: impairment knobs default to all-off; pre-v4: the
-    # push-only mode
+    # push-only mode; pre-v5: no journal alongside
     meta.setdefault("impair", dict(_IMPAIR_DEFAULTS))
     meta.setdefault("pull", dict(_PULL_DEFAULTS))
+    meta.setdefault("resilience", {})
     if params is not None:
         for f in _SHAPE_FIELDS:
             if getattr(params, f) != stored[f]:

@@ -31,6 +31,13 @@ from .ingest import (fetch_vote_accounts_rpc, filter_accounts,
                      synthetic_accounts)
 from .obs import Heartbeat, get_registry
 from .oracle.rustrng import ChaChaRng
+from . import resilience
+from .resilience import (RESUMABLE_EXIT_CODE, DeviceDispatchError,
+                         InfluxTee, ResumableInterrupt, RunJournal,
+                         check_interrupt, journal_path,
+                         replay_influx_lines, restore_pubkey_counter,
+                         restore_stats, run_key_from_config, signal_guard,
+                         stats_unit_payload, supervised_call, supervision)
 from .sinks import (DatapointQueue, InfluxDataPoint, InfluxThread,
                     load_dotenv)
 from .stats.gossip_stats import GossipStats, GossipStatsCollection
@@ -41,6 +48,23 @@ log = logging.getLogger("gossip_sim_tpu")
 # same bar — a run warned as "poor coverage" is exactly one not yet
 # recovered, so the two must never drift apart
 POOR_COVERAGE_THRESHOLD = COVERAGE_RECOVERY_THRESHOLD
+
+#: measured rounds per device->host harvest block (single-origin and
+#: origin-rank paths).  Module-level so resilience tests can shrink it and
+#: exercise multi-block journals without thousand-round runs.
+HARVEST_BLOCK = 256
+
+
+def _blocked(out):
+    """``jax.block_until_ready`` on a ``(state, rows)`` pair, returning it.
+
+    Supervised dispatch closures use this so device-side failures (and
+    hangs, for the watchdog) surface inside the attempt instead of at a
+    later harvest."""
+    import jax
+    state, rows = out
+    jax.block_until_ready(rows)
+    return state, rows
 
 
 def _warn_shape_truncation(rows, params) -> tuple[int, int]:
@@ -380,9 +404,41 @@ def build_parser() -> argparse.ArgumentParser:
                         "params) to this .npz after each measured block and "
                         "at the end; resume with --resume")
     p.add_argument("--resume", dest="resume_path", default="",
-                   help="tpu backend: load a --checkpoint-path .npz and "
-                        "continue from its recorded iteration (bit-exact; "
-                        "stats are recorded for the remaining rounds)")
+                   help="tpu backend: continue an interrupted run "
+                        "bit-exactly. Single runs: load a "
+                        "--checkpoint-path .npz and continue from its "
+                        "recorded iteration (stats are recorded for the "
+                        "remaining rounds). Sweeps / --sweep-lanes / "
+                        "--all-origins: replay the run journal's "
+                        "committed units into stats/Influx verbatim and "
+                        "restart from the first uncommitted unit "
+                        "(resilience.py)")
+    p.add_argument("--checkpoint-every-s", type=float, default=0.0,
+                   help="minimum seconds between periodic checkpoint "
+                        "autosaves on the single-run path (0 = save "
+                        "after every harvest block)")
+    p.add_argument("--device-timeout-s", type=float, default=0.0,
+                   help="watchdog bound on one engine dispatch "
+                        "(resilience.py): a call exceeding this is "
+                        "treated as a hung device and retried with "
+                        "backoff (0 = no watchdog)")
+    p.add_argument("--device-retries", type=int, default=2,
+                   help="transient-failure retries per supervised "
+                        "engine dispatch (exponential backoff)")
+    p.add_argument("--on-device-failure", default="",
+                   choices=["", "cpu-fallback", "abort"],
+                   help="after the retry budget: cpu-fallback re-executes "
+                        "the failed unit on the CPU backend and flags the "
+                        "run report (device_failures/fallback_units); "
+                        "abort exits with the resumable exit code "
+                        f"({RESUMABLE_EXIT_CODE}) and a committed "
+                        "journal. Passing either value enables "
+                        "supervision even without --device-timeout-s")
+    p.add_argument("--influx-spool", default="", metavar="PATH",
+                   help="durable sink spool: Influx points dropped after "
+                        "retry exhaustion or queue overflow are appended "
+                        "to PATH as line protocol instead of discarded; "
+                        "re-send with tools/influx_replay.py")
     return p
 
 
@@ -450,6 +506,11 @@ def config_from_args(args) -> Config:
         sweep_lanes=args.sweep_lanes,
         checkpoint_path=args.checkpoint_path,
         resume_path=args.resume_path,
+        checkpoint_every_s=args.checkpoint_every_s,
+        device_timeout_s=args.device_timeout_s,
+        device_retries=args.device_retries,
+        on_device_failure=args.on_device_failure,
+        influx_spool=args.influx_spool,
         mesh_devices=args.mesh_devices,
         mesh_node_shards=args.mesh_node_shards,
         jax_profile_dir=args.jax_profile_dir,
@@ -751,12 +812,24 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
         failed_idx = np.nonzero(np.asarray(state.failed)[0])[0]
         stats.set_failed_nodes({index.pubkeys[i] for i in failed_idx})
 
-    def _save_checkpoint(iteration):
-        if config.checkpoint_path:
-            from .checkpoint import save_state
-            with reg.span("checkpoint/save"):
-                save_state(config.checkpoint_path, state, params, config,
-                           iteration=iteration)
+    last_save = [float("-inf")]
+
+    def _save_checkpoint(iteration, force=True):
+        """Write the v4/v5 state npz.  Periodic block saves pass
+        ``force=False`` and are throttled by --checkpoint-every-s (0 =
+        every block, the pre-resilience cadence); boundary saves (end of
+        run, fail event, graceful shutdown) always write."""
+        if not config.checkpoint_path:
+            return
+        now = time.monotonic()
+        if (not force and config.checkpoint_every_s > 0
+                and now - last_save[0] < config.checkpoint_every_s):
+            return
+        from .checkpoint import save_state
+        with reg.span("checkpoint/save"):
+            save_state(config.checkpoint_path, state, params, config,
+                       iteration=iteration)
+        last_save[0] = now
 
     if config.resume_path and 0 <= params.fail_at < start_iter:
         _record_failed()
@@ -772,9 +845,11 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
         # sweep hit the jit cache and record as plain warm-up compute
         cm, _ = _engine_call_span(reg, fallback="engine/warmup")
         with cm:
-            state, wrows = run_rounds(params, tables, origins, state,
-                                      warm - start_iter, start_it=start_iter)
-            jax.block_until_ready(wrows)
+            state, wrows = _dispatch_supervised(
+                config, "warmup-scan",
+                lambda st: _blocked(run_rounds(params, tables, origins, st,
+                                               warm - start_iter,
+                                               start_it=start_iter)), state)
         if config.heal_at >= 0 and config.heal_at < warm:
             # post-heal coverage inside the warm-up scan still feeds the
             # recovery metric (iteration-exact, like the oracle loop and
@@ -794,7 +869,7 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
     # Harvest measured rounds in blocks to bound host-side detail arrays.
     profile_cm = (jax.profiler.trace(config.jax_profile_dir)
                   if config.jax_profile_dir else contextlib.nullcontext())
-    block = 256
+    block = HARVEST_BLOCK
     done = max(0, start_iter - warm)
     hb = Heartbeat(measured, label=f"sim {sim_iter} measured rounds",
                    unit="iter")
@@ -807,11 +882,17 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
             # first measured block carries the compile: keep it out of the
             # steady-state rounds span and throughput denominators
             cm, counted = _engine_call_span(reg)
+
+            def _block_dispatch(st):
+                st, rws = run_rounds(params, tables, origins, st, n_it,
+                                     start_it=start_it, detail=True,
+                                     trace=tracer is not None)
+                return st, jax.tree_util.tree_map(np.asarray, rws)
+
             with cm:
-                state, rows = run_rounds(params, tables, origins, state, n_it,
-                                         start_it=start_it, detail=True,
-                                         trace=tracer is not None)
-                rows = jax.tree_util.tree_map(np.asarray, rows)
+                state, rows = _dispatch_supervised(
+                    config, f"measured-block-{start_it}", _block_dispatch,
+                    state)
             blk_wall = time.perf_counter() - t_blk
             if counted:
                 reg.add("origin_iters", n_it)
@@ -839,7 +920,21 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
             hb.beat(done)
             _push_sim_perf_point(dp_queue, sim_iter, start_ts, blk_wall,
                                  n_it, 1)
-            _save_checkpoint(warm + done)
+            _save_checkpoint(warm + done, force=False)
+            if resilience.shutdown_requested():
+                # finish-the-harvest contract: this block's stats are fed
+                # and the state is durably saved before exiting resumable
+                _save_checkpoint(warm + done)
+                if tracer is not None:
+                    tracer.finalize()
+                raise ResumableInterrupt(
+                    f"single-run checkpoint saved at iteration "
+                    f"{warm + done}; resume with --resume "
+                    f"{config.checkpoint_path}"
+                    if config.checkpoint_path else
+                    f"run stopped at iteration {warm + done} with no "
+                    f"--checkpoint-path; this simulation restarts from "
+                    f"scratch")
     if tracer is not None:
         tracer.finalize()
         log.info("protocol trace written to %s", config.trace_dir)
@@ -929,10 +1024,23 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
 
     from .engine import init_state, make_cluster_tables, run_rounds
 
+    # Journal + state checkpoint (resilience.py; lifts the old "not
+    # supported by the batched origin-rank sweep" warning): one unit per
+    # measured harvest block.  A unit commits every origin column's
+    # parity snapshot + the block's wire lines, alongside a v5 state npz;
+    # resume restores the state + per-column stats and replays the lines.
+    journal = _open_journal(
+        config, "origin-rank",
+        # Config carries only origin_ranks[0]; the full swept list shapes
+        # every unit, so it must be part of the drift fingerprint
+        {"origin_ranks": [int(r) for r in
+                          origin_ranks[:config.num_simulations]]})
+    if journal is not None:
+        restore_pubkey_counter(journal.header_pubkey_counter())
+    first_block = journal.committed_prefix() if journal is not None else 0
+    feed = _unit_feed(journal, dp_queue)
+
     accounts, source_label = load_cluster_accounts(config, json_rpc_url)
-    if config.checkpoint_path or config.resume_path:
-        log.warning("WARNING: --checkpoint-path/--resume are not supported "
-                    "by the batched origin-rank sweep; ignoring")
     index = NodeIndex.from_stakes(accounts)
     stakes = dict(accounts)
     N = len(index)
@@ -972,65 +1080,107 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
             VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS, stakes)
         stats_list.append(stats)
 
-    if dp_queue is not None:
-        dp = InfluxDataPoint(start_ts, 0)
-        dp.create_test_type_point(
-            config.num_simulations, config.gossip_iterations,
-            config.warm_up_rounds, config.step_size, len(accounts),
-            config.probability_of_rotation, source_label,
-            str(float(origin_ranks[0])), config.test_type)
-        dp.create_validator_stake_distribution_histogram_point(
-            stats_list[0].get_validator_stake_distribution_histogram())
-        dp.set_start()
-        dp_queue.push_back(dp)
+    warm = min(config.warm_up_rounds, config.gossip_iterations)
+    measured = config.gossip_iterations - warm
+    block = HARVEST_BLOCK
+    done = 0
 
     tracer = None
     if config.trace_dir:
         # one trace, one origin column per swept rank (per-origin RNG
-        # streams make each column bit-identical to its serial run)
+        # streams make each column bit-identical to its serial run); on
+        # resume the writer merges already-captured segments
         from .obs.trace import block_from_engine_rows
         tracer = _make_trace_writer(
             config, index, [index.index_of(pk) for pk in origin_pks],
             backend="tpu", params=params)
 
-    log.info("Simulating Gossip and setting active sets. Please wait.....")
-    with reg.span("engine/init"):
-        state = init_state(jax.random.PRNGKey(config.seed), tables, origins,
-                           params)
-        jax.block_until_ready(state)
-    log.info("Simulation Complete!")
+    if first_block > 0:
+        # resume: state from the v5 npz, per-column stats from the last
+        # committed unit's snapshots, wire lines replayed verbatim
+        from .checkpoint import restore_sim_state
+        ckpt = config.resume_path or config.checkpoint_path
+        with reg.span("checkpoint/restore"):
+            state, _, meta = restore_sim_state(ckpt, params, tables)
+        last = journal.records[first_block - 1]
+        stats_list = [restore_stats(p, configs[col], stakes)
+                      for col, p in enumerate(last["sims"])]
+        for b in range(first_block):
+            replay_influx_lines(dp_queue,
+                                journal.records[b].get("lines", []))
+        done = min(first_block * block, measured)
+        if int(meta.get("iteration", warm + done)) != warm + done:
+            # a kill between save_state and journal.commit leaves the
+            # state one block ahead of the journal; the missing block's
+            # stats cannot be reconstructed, so continuing would silently
+            # break the bit-exactness contract
+            raise SystemExit(
+                f"ERROR: checkpoint {ckpt} is at iteration "
+                f"{meta.get('iteration')} but the journal holds "
+                f"{first_block} committed block(s) (= iteration "
+                f"{warm + done}); the run died between the state save "
+                f"and the journal commit. Remove {journal.path} and "
+                f"{ckpt} to start fresh.")
+        log.info("resume: origin-rank sweep restored at iteration %s "
+                 "(%s/%s measured rounds done)", warm + done, done,
+                 measured)
+    else:
+        if dp_queue is not None:
+            dp = InfluxDataPoint(start_ts, 0)
+            dp.create_test_type_point(
+                config.num_simulations, config.gossip_iterations,
+                config.warm_up_rounds, config.step_size, len(accounts),
+                config.probability_of_rotation, source_label,
+                str(float(origin_ranks[0])), config.test_type)
+            dp.create_validator_stake_distribution_histogram_point(
+                stats_list[0].get_validator_stake_distribution_histogram())
+            dp.set_start()
+            feed.push_back(dp)
 
-    warm = min(config.warm_up_rounds, config.gossip_iterations)
-    if warm > 0:
-        for it in range(0, warm, 10):
-            log.info("GOSSIP ITERATION: %s", it)
-        cm, _ = _engine_call_span(reg, fallback="engine/warmup")
-        with cm:
-            state, wrows = run_rounds(params, tables, origins, state, warm)
-            jax.block_until_ready(wrows)
-        if config.heal_at >= 0 and config.heal_at < warm:
-            # heal inside warm-up: the recovery metric still needs every
-            # post-heal round (iteration-exact, like the other run paths)
-            cov_w = np.asarray(wrows["coverage"])            # [warm, R]
-            for it in range(config.heal_at, warm):
-                for col in range(R):
-                    stats_list[col].note_post_heal_coverage(
-                        it, float(cov_w[it, col]))
-    measured = config.gossip_iterations - warm
-    block = 256
-    done = 0
+        log.info("Simulating Gossip and setting active sets. "
+                 "Please wait.....")
+        with reg.span("engine/init"):
+            state = init_state(jax.random.PRNGKey(config.seed), tables,
+                               origins, params)
+            jax.block_until_ready(state)
+        log.info("Simulation Complete!")
+
+        if warm > 0:
+            for it in range(0, warm, 10):
+                log.info("GOSSIP ITERATION: %s", it)
+            cm, _ = _engine_call_span(reg, fallback="engine/warmup")
+            with cm:
+                state, wrows = _dispatch_supervised(
+                    config, "origin-rank-warmup",
+                    lambda st: _blocked(run_rounds(params, tables, origins,
+                                                   st, warm)), state)
+            if config.heal_at >= 0 and config.heal_at < warm:
+                # heal inside warm-up: the recovery metric still needs
+                # every post-heal round (iteration-exact, like the other
+                # run paths)
+                cov_w = np.asarray(wrows["coverage"])        # [warm, R]
+                for it in range(config.heal_at, warm):
+                    for col in range(R):
+                        stats_list[col].note_post_heal_coverage(
+                            it, float(cov_w[it, col]))
     hb = Heartbeat(measured, label="origin-rank sweep measured rounds",
                    unit="iter")
+    unit = first_block
     while done < measured:
         n_it = min(block, measured - done)
         start_it = warm + done
         t_blk = time.perf_counter()
         cm, counted = _engine_call_span(reg)
+
+        def _block_dispatch(st):
+            st, rws = run_rounds(params, tables, origins, st, n_it,
+                                 start_it=start_it, detail=True,
+                                 trace=tracer is not None)
+            return st, jax.tree_util.tree_map(np.asarray, rws)
+
         with cm:
-            state, rows = run_rounds(params, tables, origins, state, n_it,
-                                     start_it=start_it, detail=True,
-                                     trace=tracer is not None)
-            rows = jax.tree_util.tree_map(np.asarray, rows)
+            state, rows = _dispatch_supervised(
+                config, f"origin-rank-block-{unit}", _block_dispatch, state)
         blk_wall = time.perf_counter() - t_blk
         if counted:
             reg.add("origin_iters", R * n_it)
@@ -1038,7 +1188,7 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         if tracer is not None:
             with reg.span("trace/write"):
                 seg = tracer.add_block(start_it, block_from_engine_rows(rows))
-            _push_sim_trace_point(dp_queue, 0, start_ts, seg)
+            _push_sim_trace_point(feed, 0, start_ts, seg)
         with reg.span("stats/harvest"):
             _warn_shape_truncation(rows, params)
             for t in range(n_it):
@@ -1047,23 +1197,41 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
                     log.info("GOSSIP ITERATION: %s", it)
                 for col in range(R):
                     if it % 10 == 0:
-                        _push_config_point(configs[col], dp_queue, col,
+                        _push_config_point(configs[col], feed, col,
                                            start_ts)
                     _feed_measured_round(stats_list[col], rows, t, col, it,
                                          configs[col], index, stakes,
-                                         origin_pks[col], dp_queue, col,
+                                         origin_pks[col], feed, col,
                                          start_ts)
         done += n_it
+        _push_sim_perf_point(feed, 0, start_ts, blk_wall, n_it, R)
+        if journal is not None:
+            from .checkpoint import save_state
+            with reg.span("checkpoint/save"):
+                save_state(config.checkpoint_path or config.resume_path,
+                           state, params, config, iteration=warm + done,
+                           resilience={
+                               "journal": os.path.basename(journal.path),
+                               "committed_units": unit + 1})
+            journal.commit(unit, {
+                "iteration": warm + done,
+                "sims": [stats_unit_payload(stats_list[col])
+                         for col in range(R)],
+                "lines": _take_unit_lines(feed)})
+            hb.note_committed(done)
+        unit += 1
+        check_interrupt(journal)
         hb.beat(done)
-        _push_sim_perf_point(dp_queue, 0, start_ts, blk_wall, n_it, R)
 
+    if journal is not None:
+        journal.close()
     if tracer is not None:
         tracer.finalize()
         log.info("protocol trace written to %s", config.trace_dir)
     for col in range(R):
         _feed_message_counters(stats_list[col], state, col, index)
         _finalize_sim_stats(configs[col], stats_list[col], stakes,
-                            stats_collection, dp_queue, col, start_ts)
+                            stats_collection, feed, col, start_ts)
 
 
 def run_lane_sweep(config: Config, json_rpc_url: str, origin_ranks,
@@ -1096,7 +1264,6 @@ def run_lane_sweep(config: Config, json_rpc_url: str, origin_ranks,
     import jax
     import jax.numpy as jnp
 
-    from .checkpoint import guard_lane_checkpoint
     from .engine import (broadcast_state, check_lane_knobs, init_state,
                          lane_state, make_cluster_tables, merge_lane_statics,
                          run_rounds_lanes, stack_knobs)
@@ -1108,13 +1275,27 @@ def run_lane_sweep(config: Config, json_rpc_url: str, origin_ranks,
             "flight recorder captures one sim's event stream per trace and "
             "a lane batch runs K sims inside one device program. Drop "
             "--sweep-lanes to trace a serial sweep (one trace per sim).")
-    guard_lane_checkpoint(config)
 
     K = config.num_simulations
     L = max(1, min(config.sweep_lanes, K))
     n_batches = (K + L - 1) // L
     sweep = [_stepped_sweep_config(config, i, origin_ranks)
              for i in range(K)]
+
+    # Lane-mode resumability (resilience.py; lifts PR 6's explicit
+    # guard_lane_checkpoint gap): one journal unit per lane batch.  A
+    # batch commits its sims' parity snapshots + wire lines after the
+    # single [K,...] harvest; resume replays committed batches and
+    # recomputes from the first uncommitted one — base_state re-derives
+    # from the seed, so no device state needs to be stored.
+    journal = _open_journal(config, "lane-sweep")
+    first_batch = journal.committed_prefix() if journal is not None else 0
+    if journal is not None:
+        # the synthetic cluster load below must see the counter position
+        # the interrupted run recorded at sweep start (no-op on a fresh
+        # journal or non-synthetic sources)
+        restore_pubkey_counter(journal.header_pubkey_counter())
+    feed = _unit_feed(journal, dp_queue)
 
     accounts, source_label = load_cluster_accounts(config, json_rpc_url)
     if len(accounts) < config.origin_rank:
@@ -1183,18 +1364,36 @@ def run_lane_sweep(config: Config, json_rpc_url: str, origin_ranks,
     with profile_cm:
         for b in range(n_batches):
             ids = list(range(b * L, min((b + 1) * L, K)))
+            if b < first_batch:
+                # journal replay: committed batches feed stats/Influx
+                # verbatim — never recomputed, never double-fed
+                payload = journal.records[b]
+                for i, sim_payload in payload.get("sims", []):
+                    log.info("##### SIMULATION ITERATION: %s (replayed "
+                             "from journal) #####", i)
+                    _replay_finished_sim(sim_payload, sweep[int(i)][0],
+                                         stakes, stats_collection)
+                replay_influx_lines(dp_queue, payload.get("lines", []))
+                hb.note_committed(b + 1)
+                hb.beat(b + 1)
+                continue
             padded = ids + [ids[-1]] * (L - len(ids))
             kstack = stack_knobs([knob_list[i] for i in padded])
-            states = broadcast_state(base_state, L)
             t_blk = time.perf_counter()
             # batch 1 carries the (single) compile; batches 2.. are pure
             # warm execution and feed the throughput denominators
             cm, counted = _engine_call_span(reg)
+
+            def _lane_dispatch(base):
+                sts = broadcast_state(base, L)
+                sts, rws = run_rounds_lanes(static, tables, origins,
+                                            sts, kstack, total,
+                                            detail=True)
+                return sts, jax.tree_util.tree_map(np.asarray, rws)
+
             with cm:
-                states, rows = run_rounds_lanes(static, tables, origins,
-                                                states, kstack, total,
-                                                detail=True)
-                rows = jax.tree_util.tree_map(np.asarray, rows)
+                states, rows = _dispatch_supervised(
+                    config, f"lane-batch-{b}", _lane_dispatch, base_state)
             blk_wall = time.perf_counter() - t_blk
             if counted:
                 reg.add("origin_iters", len(ids) * measured)
@@ -1206,14 +1405,23 @@ def run_lane_sweep(config: Config, json_rpc_url: str, origin_ranks,
                                   lane_rows(rows, pos), lane_state(states,
                                                                    pos),
                                   params_list[i], index, stakes,
-                                  origin_pubkey, dp_queue, i, start_ts,
+                                  origin_pubkey, feed, i, start_ts,
                                   warm, total, len(accounts), source_label)
                     _finalize_sim_stats(sweep[i][0], stats_list[i], stakes,
-                                        stats_collection, dp_queue, i,
+                                        stats_collection, feed, i,
                                         start_ts)
-            _push_sim_perf_point(dp_queue, ids[0], start_ts, blk_wall,
+            _push_sim_perf_point(feed, ids[0], start_ts, blk_wall,
                                  measured, len(ids))
+            if journal is not None:
+                journal.commit(b, {
+                    "sims": [[i, stats_unit_payload(stats_list[i])]
+                             for i in ids],
+                    "lines": _take_unit_lines(feed)})
+                hb.note_committed(b + 1)
+            check_interrupt(journal)
             hb.beat(b + 1)
+    if journal is not None:
+        journal.close()
     hb.finish()
 
 
@@ -1349,6 +1557,17 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
                          run_rounds)
     from .stats.aggregate import AllOriginsStats
 
+    # Journal (resilience.py): one unit per origin batch; the aggregate
+    # accumulators snapshot into an .aggstate.npz sidecar at each commit,
+    # so resume reloads them and re-dispatches only uncommitted batches.
+    journal = _open_journal(config, "all-origins")
+    if journal is not None:
+        restore_pubkey_counter(journal.header_pubkey_counter())
+    first_unit = journal.committed_prefix() if journal is not None else 0
+    sidecar = (journal.path[: -len(".journal")] + ".aggstate.npz"
+               if journal is not None else None)
+    feed = _unit_feed(journal, dp_queue)
+
     if accounts is None:
         accounts, _ = load_cluster_accounts(config, json_rpc_url)
     reg = get_registry()
@@ -1415,6 +1634,46 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
     # run's delta so library callers invoking run_all_origins repeatedly
     # (tests, the driver dryrun) don't inherit earlier runs' padding
     padded_before = reg.counter("padded_sims")
+    padded_restored = 0
+    skip_lo = 0
+    if first_unit > 0:
+        stored_batch = int(journal.records[0].get("batch", batch))
+        if stored_batch != batch:
+            raise SystemExit(
+                f"ERROR: --resume origin batch {batch} does not match the "
+                f"journal's {stored_batch} (different --origin-batch / "
+                f"mesh?); remove {journal.path} to start fresh")
+        sd = _load_agg_sidecar(sidecar)
+        sidecar_units = int(sd.pop("committed_units", first_unit))
+        if sidecar_units == first_unit + 1:
+            # killed between the sidecar save and the journal commit: the
+            # sidecar already folded batch `first_unit`, so commit the
+            # missing record now instead of re-dispatching the batch and
+            # double-counting its origins in the aggregates
+            log.warning("WARNING: aggregate sidecar is one batch ahead of "
+                        "the journal (killed mid-commit); committing the "
+                        "missing unit %s record", first_unit)
+            journal.commit(first_unit, {"lo": int(first_unit * batch),
+                                        "batch": int(batch)})
+            first_unit += 1
+        elif sidecar_units != first_unit:
+            raise SystemExit(
+                f"ERROR: aggregate sidecar {sidecar} holds "
+                f"{sidecar_units} committed batch(es) but the journal "
+                f"holds {first_unit}; the two artifacts cannot be "
+                f"reconciled. Remove {journal.path} and {sidecar} to "
+                f"start fresh.")
+        padded_restored = int(sd.pop("padded_sims", 0))
+        agg.load_state_dict(sd)
+        for b in range(first_unit):
+            replay_influx_lines(dp_queue,
+                                journal.records[b].get("lines", []))
+        skip_lo = first_unit * batch
+        hb.note_committed(min(skip_lo, total_o))
+        hb.beat(min(skip_lo, total_o))
+        log.info("resume: all-origins restored %s committed batch(es) "
+                 "(%s/%s origins) from %s", first_unit,
+                 min(skip_lo, total_o), total_o, sidecar)
     t0 = time.time()
 
     def _dispatch(lo):
@@ -1426,7 +1685,8 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         chunk = all_origins[lo:lo + batch]
         n_valid = len(chunk)
         if n_valid < batch:
-            reg.add("padded_sims", batch - n_valid)
+            # counted at harvest, not here: a supervised retry re-runs
+            # this dispatch and would double-count the padding
             chunk = np.concatenate(
                 [chunk, np.zeros(batch - n_valid, np.int32)])
         origins = jnp.asarray(chunk, dtype=jnp.int32)
@@ -1454,7 +1714,13 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
                     lambda a: np.asarray(a)[..., :n_valid], rows)
             harvested = True
         else:
-            cm = (reg.span("engine/compile") if lo == 0
+            # first jitted call of the PROCESS carries the compile (the
+            # _engine_call_span convention) — keyed on the span count,
+            # not lo == 0, so a resumed run (skip_lo > 0) still records
+            # it and the supervisor's compile-carrier timeout exemption
+            # expires after one batch
+            cm = (reg.span("engine/compile")
+                  if reg.count("engine/compile") == 0
                   else contextlib.nullcontext())
             with cm:
                 state, rows = run_rounds(params, tables, origins, state,
@@ -1476,6 +1742,8 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         this host-side work (np.asarray transfer + stats accumulation)
         overlaps its compute instead of serializing on it."""
         lo, n_valid, state, rows, t_blk, t_disp_end, counted, harvested = job
+        if n_valid < batch:
+            reg.add("padded_sims", batch - n_valid)
         if harvested:
             blk_wall = time.perf_counter() - t_blk
         else:
@@ -1503,24 +1771,56 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
                           heal_at=config.heal_at,
                           impaired=config.impairments_on,
                           pull=config.has_pull)
-        _push_sim_perf_point(dp_queue, 0, start_ts, blk_wall,
+        _push_sim_perf_point(feed, 0, start_ts, blk_wall,
                              config.gossip_iterations, n_valid)
         log.info("all-origins: %s/%s origins done",
                  min(lo + n_valid, total_o), total_o)
+        if journal is not None:
+            sd = agg.state_dict()
+            sd["padded_sims"] = padded_restored + int(
+                reg.counter("padded_sims") - padded_before)
+            sd["committed_units"] = lo // batch + 1
+            _save_agg_sidecar(sidecar, sd)
+            journal.commit(lo // batch, {"lo": int(lo), "batch": int(batch),
+                                         "lines": _take_unit_lines(feed)})
+            hb.note_committed(min(lo + n_valid, total_o))
         hb.beat(min(lo + n_valid, total_o))
 
     # double-buffered pipeline: dispatch batch k+1 before harvesting batch
     # k, so the host-side harvest overlaps the device compute of the next
     # batch (two batches are in flight at peak — budget device memory for
-    # 2x the batch state when sizing --origin-batch)
+    # 2x the batch state when sizing --origin-batch).  A supervised run
+    # (watchdog / cpu-fallback) serializes instead: each batch is one
+    # retryable unit whose results must be on host before the next
+    # dispatch, so a failed dispatch can be re-executed in isolation.
+    supervised = supervision(config) is not None
     pending = None
-    for lo in range(0, total_o, batch):
-        job = _dispatch(lo)
-        if pending is not None:
-            _harvest(pending)
-        pending = job
+    for lo in range(skip_lo, total_o, batch):
+        if supervised:
+            def _unit(_state, lo=lo):
+                job = _dispatch(lo)
+                jb_lo, n_valid, st, rows, t_blk, t_disp, counted, hv = job
+                if not hv:
+                    # materialize inside the attempt so device failures
+                    # surface here, where the supervisor can retry
+                    rows = jax.tree_util.tree_map(
+                        lambda a: np.asarray(a)[..., :n_valid], rows)
+                st = jax.tree_util.tree_map(np.asarray, st)
+                return (jb_lo, n_valid, st, rows, t_blk, t_disp, counted,
+                        hv)
+            _harvest(_dispatch_supervised(
+                config, f"origin-batch-{lo // batch}", _unit))
+        else:
+            job = _dispatch(lo)
+            if pending is not None:
+                _harvest(pending)
+            pending = job
+        check_interrupt(journal)
     if pending is not None:
         _harvest(pending)
+        check_interrupt(journal)
+    if journal is not None:
+        journal.close()
     dt = time.time() - t0
 
     if config.trace_dir:
@@ -1543,7 +1843,8 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
             "origin_iters_per_sec": total_o * config.gossip_iterations / dt,
             "mesh_devices": mesh_dev if mesh is not None else 1,
             "mesh_node_shards": node_shards if mesh is not None else 1,
-            "padded_sims": int(reg.counter("padded_sims") - padded_before),
+            "padded_sims": padded_restored + int(
+            reg.counter("padded_sims") - padded_before),
             "hop_clamped": 0,
             "stats": agg,
         }
@@ -1566,7 +1867,8 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         "origin_iters_per_sec": total_o * config.gossip_iterations / dt,
         "mesh_devices": mesh_dev if mesh is not None else 1,
         "mesh_node_shards": node_shards if mesh is not None else 1,
-        "padded_sims": int(reg.counter("padded_sims") - padded_before),
+        "padded_sims": padded_restored + int(
+        reg.counter("padded_sims") - padded_before),
         # LDH/hop-histogram clamp guard (VERDICT r5 #7): measured hop
         # samples clamped into the top on-device bin — 0 means the
         # aggregate hop/LDH stats are exact, nonzero already warned above
@@ -1757,6 +2059,16 @@ def _finalize_sim_stats(config, stats, stakes, stats_collection, dp_queue,
     (gossip_main.rs:567-645)."""
     if stats.is_empty():
         return
+    _build_final_stats(config, stats, stakes)
+    stats_collection.push(stats)
+    _push_end_of_sim_points(config, dp_queue, sim_iter, start_ts, stats)
+
+
+def _build_final_stats(config, stats, stakes):
+    """The end-of-sim histogram builds + calculations alone — shared by
+    the live path above and the journal replay path, which re-finalizes a
+    restored snapshot instead of re-emitting its Influx points (those are
+    replayed verbatim from the journal, resilience.py)."""
     stats.build_stranded_node_histogram(
         config.gossip_iterations - config.warm_up_rounds, 0,
         config.num_buckets_for_stranded_node_hist)
@@ -1778,8 +2090,149 @@ def _finalize_sim_stats(config, stats, stakes, stats_collection, dp_queue,
     stats.build_prune_histogram(
         config.num_buckets_for_message_hist, True, stakes)
     stats.run_all_calculations()
-    stats_collection.push(stats)
-    _push_end_of_sim_points(config, dp_queue, sim_iter, start_ts, stats)
+
+
+# --------------------------------------------------------------------------
+# run journal + supervised dispatch helpers (resilience.py)
+# --------------------------------------------------------------------------
+
+def _open_journal(config: Config, kind: str, extra_key: dict | None = None):
+    """The run journal a multi-unit path keeps next to the checkpoint
+    path, or None when neither --checkpoint-path nor --resume was given.
+    On resume, the committed-unit count is logged and the caller replays
+    ``journal.records[0..committed_prefix())`` before recomputing.
+    ``extra_key`` folds per-path inputs outside the Config (e.g. the
+    full origin-rank list) into the drift fingerprint."""
+    if (config.checkpoint_path and config.resume_path
+            and config.checkpoint_path != config.resume_path):
+        # the single-run npz path supports load-old/save-new; a journal
+        # is one append-only file serving both roles, so a split pair
+        # would silently discard the resumable units next to the old path
+        raise SystemExit(
+            "ERROR: journal-mode runs (sweeps, --sweep-lanes, "
+            "--all-origins) need --checkpoint-path and --resume to name "
+            "the SAME path; got "
+            f"{config.checkpoint_path!r} vs {config.resume_path!r}")
+    base = config.checkpoint_path or config.resume_path
+    if not base:
+        return None
+    jp = journal_path(base)
+    resume = bool(config.resume_path)
+    if resume and not os.path.exists(jp):
+        log.warning("WARNING: --resume given but journal %s does not "
+                    "exist; starting the run from scratch", jp)
+    journal = RunJournal(jp, run_key_from_config(config, kind, extra_key),
+                         resume=resume)
+    k = journal.committed_prefix()
+    if k:
+        get_registry().add("resilience/resumed_units", k)
+        log.info("resume: journal %s holds %s committed unit(s); "
+                 "replaying them verbatim and restarting at unit %s",
+                 jp, k, k)
+    return journal
+
+
+def _save_agg_sidecar(path: str, state_dict: dict) -> None:
+    """Atomically persist an AllOriginsStats.state_dict() (+ the padding
+    counter) next to the journal — tmp + os.replace, same contract as
+    checkpoint.save_state."""
+    import tempfile
+    fd, tmp = tempfile.mkstemp(suffix=".npz", prefix=".aggstate-",
+                               dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **{k: np.asarray(v)
+                                      for k, v in state_dict.items()})
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _load_agg_sidecar(path: str) -> dict:
+    if not os.path.exists(path):
+        raise SystemExit(
+            f"ERROR: --resume found a journal but no aggregate sidecar at "
+            f"{path}; remove the journal to start fresh")
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _unit_feed(journal, dp_queue):
+    """The datapoint sink run paths push into: a journaling tee when a
+    journal is active (so each unit's wire lines commit with it), else
+    the plain queue."""
+    if journal is not None and dp_queue is not None:
+        return InfluxTee(dp_queue)
+    return dp_queue
+
+
+def _take_unit_lines(feed) -> list:
+    return feed.take_unit_lines() if isinstance(feed, InfluxTee) else []
+
+
+def _replay_finished_sim(payload, config, stakes, stats_collection):
+    """Rebuild one journaled, *finished* sim into the collection: restore
+    the parity snapshot, re-run the end-of-sim calculations (exact — they
+    are pure functions of the restored series), and push in sweep order.
+    Influx is NOT re-fed here; the unit's stored lines replay
+    separately."""
+    if not payload:
+        return None
+    stats = restore_stats(payload, config, stakes)
+    if not stats.is_empty():
+        _build_final_stats(config, stats, stakes)
+        stats_collection.push(stats)
+    return stats
+
+
+def _dispatch_supervised(config: Config, label: str, run_fn, state=None):
+    """Run one engine unit under the resilience supervisor when enabled
+    (resilience.supervision), else call straight through (zero added
+    work on the default path).
+
+    ``run_fn(state)`` performs the dispatch and must materialize its
+    results on the host before returning (so device failures surface
+    inside the attempt).  When supervised, ``state`` is snapshotted to
+    host numpy first and every attempt — retries and the CPU fallback —
+    rebuilds fresh device arrays from it, because the engine donates its
+    state buffers and a failed dispatch may have invalidated them."""
+    policy = supervision(config)
+    if policy is None:
+        return run_fn(state)
+    import jax
+    import jax.numpy as jnp
+
+    if policy.timeout_s > 0 and get_registry().count("engine/compile") == 0:
+        # The run's FIRST jitted dispatch carries the compile (the same
+        # convention _engine_call_span encodes).  A slow compile is not a
+        # hung device — and XLA compiles measurably slower on a watchdog
+        # thread — so the carrier runs inline, unguarded by the timeout;
+        # retry + CPU fallback still cover its *errors*.  Warm dispatches
+        # (every later unit, where a stall means a wedged device) get the
+        # full hang watchdog.
+        from .resilience import DispatchPolicy
+        policy = DispatchPolicy(timeout_s=0.0, retries=policy.retries,
+                                backoff_s=policy.backoff_s,
+                                on_failure=policy.on_failure)
+
+    host = (jax.tree_util.tree_map(np.asarray, state)
+            if state is not None else None)
+
+    def _attempt():
+        st = (jax.tree_util.tree_map(jnp.asarray, host)
+              if host is not None else None)
+        return run_fn(st)
+
+    def _fallback():
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            return _attempt()
+
+    return supervised_call(label, _attempt, policy, cpu_fallback=_fallback)
 
 
 # --------------------------------------------------------------------------
@@ -1801,9 +2254,10 @@ def _drain_influx(dp_queue, influx_thread):
         influx_thread.join()
     sender = influx_thread.sender_stats()
     sender["queue_depth_at_exit"] = len(dp_queue)
-    log.info("influx sender: %s point(s) sent, %s dropped, %s "
-             "transient-failure retr%s", sender["points_sent"],
-             sender["dropped_points"], sender["retries"],
+    log.info("influx sender: %s point(s) sent, %s dropped, %s spooled, "
+             "%s transient-failure retr%s", sender["points_sent"],
+             sender["dropped_points"], sender.get("spooled_points", 0),
+             sender["retries"],
              "y" if sender["retries"] == 1 else "ies")
     return sender
 
@@ -1981,6 +2435,21 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
             return
         log.warning("WARNING: --sweep-lanes %s ignored (%s); running the "
                     "serial sweep", config.sweep_lanes, blocker)
+    # Serial sweep: with --checkpoint-path each completed sim is one
+    # journal unit; --resume replays committed sims into stats/Influx
+    # verbatim and restarts at the first uncommitted one (resilience.py).
+    # Single runs (num_simulations == 1) keep the mid-scan state
+    # checkpoint semantics of _run_tpu_backend instead.
+    journal = (_open_journal(
+        config, "serial-sweep",
+        # the full rank list shapes ORIGIN_RANK units (Config holds only
+        # origin_ranks[0]); harmless constant for every other test type
+        {"origin_ranks": [int(r) for r in
+                          origin_ranks[:config.num_simulations]]}
+        if config.test_type == Testing.ORIGIN_RANK else None)
+        if config.num_simulations > 1 else None)
+    feed = _unit_feed(journal, dp_queue)
+    first = journal.committed_prefix() if journal is not None else 0
     hb = Heartbeat(config.num_simulations, label="sweep", unit="simulation")
     for i in range(config.num_simulations):
         c, start = _stepped_sweep_config(config, i, origin_ranks)
@@ -1989,9 +2458,39 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
             # holds its own manifest + segments
             c = c.stepped(trace_dir=os.path.join(config.trace_dir,
                                                  f"sim{i:03d}"))
-        run_simulation(c, json_rpc_url, collection, dp_queue, i, start_ts,
+        if journal is not None:
+            # sim-level units own resumability; the per-sim runner must
+            # not also write the single-run state npz
+            c = c.stepped(checkpoint_path="", resume_path="")
+        if i < first:
+            payload = journal.records[i]
+            # loading the cluster exactly as the live sim would keeps the
+            # synthetic pubkey counter (and with it every later sim's
+            # cluster) on the uninterrupted run's sequence
+            accounts, _ = load_cluster_accounts(c, json_rpc_url)
+            log.info("##### SIMULATION ITERATION: %s (replayed from "
+                     "journal) #####", i)
+            _replay_finished_sim(payload.get("sim"), c, dict(accounts),
+                                 collection)
+            replay_influx_lines(dp_queue, payload.get("lines", []))
+            hb.note_committed(i + 1)
+            hb.beat(i + 1)
+            continue
+        before = len(collection.collection)
+        run_simulation(c, json_rpc_url, collection, feed, i, start_ts,
                        start)
+        if journal is not None:
+            sim_payload = (stats_unit_payload(collection.collection[-1])
+                           if len(collection.collection) > before else None)
+            journal.commit(i, {"sim": sim_payload,
+                               "lines": _take_unit_lines(feed)})
+            hb.note_committed(i + 1)
+        # honored with or without a journal: a SIGTERM'd sweep stops at
+        # the sim boundary either way (resume replays only if journaled)
+        check_interrupt(journal)
         hb.beat(i + 1)
+    if journal is not None:
+        journal.close()
     if config.num_simulations > 1:
         hb.finish()
 
@@ -2003,8 +2502,10 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     config = config_from_args(args)
     # one process == one run: start the telemetry registry clean so spans,
-    # counters and the run report cover exactly this invocation
+    # counters and the run report cover exactly this invocation, and clear
+    # any shutdown request a previous in-process run left behind
     get_registry().reset()
+    resilience.reset_shutdown()
     origin_ranks = args.origin_rank
     if any(r < 1 for r in origin_ranks):
         log.error("ERROR: --origin-rank values must be >= 1 (1 = highest "
@@ -2059,18 +2560,44 @@ def main(argv=None) -> int:
             return 1
         influx_thread = InfluxThread.spawn(
             get_influx_url(args.influx), username, password, database,
-            dp_queue)
+            dp_queue, spool_path=config.influx_spool)
+
+    collection = None
+    try:
+        with signal_guard():
+            if config.all_origins:
+                if config.backend != "tpu":
+                    log.error("--all-origins requires --backend tpu")
+                    return 1
+                if dp_queue is not None:
+                    log.info("all-origins: emitting run-level aggregate "
+                             "Influx series (per-iteration series are a "
+                             "single-origin feature)")
+                summary = run_all_origins(config, args.json_rpc_url,
+                                          dp_queue, start_ts)
+            else:
+                collection = GossipStatsCollection()
+                collection.set_number_of_simulations(config.num_simulations)
+                dispatch_sweeps(config, args.json_rpc_url, origin_ranks,
+                                collection, dp_queue, start_ts)
+    except (ResumableInterrupt, DeviceDispatchError) as e:
+        # every finished unit is committed; drain what the sinks hold,
+        # stamp a (partial) run report, and exit with the distinct
+        # resumable code so a wrapper can loop on --resume
+        log.warning("run interrupted resumably: %s", e)
+        influx_stats = _drain_influx(dp_queue, influx_thread)
+        stats = faults = None
+        if collection is not None:
+            stats, faults = _collection_summaries(collection)
+        _write_run_report(config, stats=stats, faults=faults,
+                          influx=influx_stats)
+        ckpt = config.checkpoint_path or config.resume_path
+        log.warning("exiting with resumable code %s%s", RESUMABLE_EXIT_CODE,
+                    f"; resume with --resume {ckpt}" if ckpt else
+                    " (no --checkpoint-path: a re-run starts from scratch)")
+        return RESUMABLE_EXIT_CODE
 
     if config.all_origins:
-        if config.backend != "tpu":
-            log.error("--all-origins requires --backend tpu")
-            return 1
-        if dp_queue is not None:
-            log.info("all-origins: emitting run-level aggregate Influx "
-                     "series (per-iteration series are a single-origin "
-                     "feature)")
-        summary = run_all_origins(config, args.json_rpc_url, dp_queue,
-                                  start_ts)
         influx_stats = _drain_influx(dp_queue, influx_thread)
         stats = {
             "coverage_mean": summary["coverage_mean"],
@@ -2104,11 +2631,6 @@ def main(argv=None) -> int:
         _write_run_report(config, stats=stats, faults=faults,
                           influx=influx_stats)
         return 0
-
-    collection = GossipStatsCollection()
-    collection.set_number_of_simulations(config.num_simulations)
-    dispatch_sweeps(config, args.json_rpc_url, origin_ranks, collection,
-                    dp_queue, start_ts)
 
     influx_stats = _drain_influx(dp_queue, influx_thread)
     stats, faults = _collection_summaries(collection)

@@ -129,8 +129,28 @@ class Config:
                                     # Only traced-knob test types are
                                     # lane-eligible (cli.LANE_SWEEP_TYPES);
                                     # others warn and run serially
-    checkpoint_path: str = ""       # save sim state (periodically + at end)
-    resume_path: str = ""           # load sim state and continue
+    checkpoint_path: str = ""       # save sim state (periodically + at end);
+                                    # multi-unit runs (sweeps, lane mode,
+                                    # --all-origins) additionally keep a
+                                    # sibling run journal (resilience.py)
+    resume_path: str = ""           # load sim state / journal and continue
+    checkpoint_every_s: float = 0.0  # min seconds between periodic
+                                    # checkpoint autosaves on the single-
+                                    # run path (0 = every harvest block,
+                                    # the pre-resilience cadence)
+    device_timeout_s: float = 0.0   # watchdog bound on one engine
+                                    # dispatch (resilience.py); 0 = off
+    device_retries: int = 2         # transient-failure retries per
+                                    # supervised dispatch
+    on_device_failure: str = ""     # "" = unsupervised unless a timeout
+                                    # is set; "cpu-fallback" re-executes
+                                    # the failed unit on the CPU backend;
+                                    # "abort" exits with the resumable
+                                    # exit code (journal committed)
+    influx_spool: str = ""          # durable spool file: Influx points
+                                    # dropped after retry exhaustion are
+                                    # appended here as line protocol and
+                                    # re-sendable via tools/influx_replay
     mesh_devices: int = 0           # 0 = all available devices
     mesh_node_shards: int = 1       # shard the per-origin node axis over
                                     # this many devices per origin-shard

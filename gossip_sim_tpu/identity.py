@@ -20,7 +20,6 @@ the I/O edge.  This module provides:
 
 from __future__ import annotations
 
-import itertools
 import threading
 from dataclasses import dataclass
 
@@ -90,7 +89,7 @@ class Pubkey:
 
 
 _unique_lock = threading.Lock()
-_unique_counter = itertools.count(1)
+_unique_counter = 1
 
 
 def pubkey_new_unique() -> Pubkey:
@@ -99,16 +98,27 @@ def pubkey_new_unique() -> Pubkey:
     Mirrors ``Pubkey::new_unique`` so reference test fixtures (hardcoded base58
     strings like ``1111111QLbz7JHiBTspS962RLKV8GndWFwiEaqKM``) reproduce.
     """
+    global _unique_counter
     with _unique_lock:
-        i = next(_unique_counter)
+        i = _unique_counter
+        _unique_counter += 1
     return Pubkey(i.to_bytes(8, "big") + b"\0" * 24)
 
 
 def reset_unique_pubkeys(start: int = 1) -> None:
-    """Reset the new_unique counter (test fixtures only)."""
+    """Reset the new_unique counter (test fixtures, and journal resume —
+    a resumed sweep restores the counter so later synthetic clusters draw
+    the same pubkeys an uninterrupted run would, resilience.py)."""
     global _unique_counter
     with _unique_lock:
-        _unique_counter = itertools.count(start)
+        _unique_counter = int(start)
+
+
+def peek_unique_pubkeys() -> int:
+    """The next value ``pubkey_new_unique`` will consume (journal
+    position marker; does not advance the counter)."""
+    with _unique_lock:
+        return _unique_counter
 
 
 def get_stake_bucket(stake: int) -> int:

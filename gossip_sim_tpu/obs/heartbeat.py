@@ -33,9 +33,18 @@ class Heartbeat:
         self.unit = unit
         self.interval_s = interval_s
         self.beats_logged = 0
+        # resumability marker (resilience.py): units durably committed to
+        # the run journal; None = this loop does not journal
+        self.committed = None
         self._log = logger if logger is not None else log
         self._t0 = time.monotonic()
         self._last = self._t0
+
+    def note_committed(self, committed_units: int) -> None:
+        """Record journal progress; subsequent beats carry a
+        "committed i/K, resumable" marker so an operator watching the log
+        knows exactly how much a preemption would preserve."""
+        self.committed = max(0, int(committed_units))
 
     def _format(self, done: int, now: float) -> str:
         # Hardened for the degenerate ticks (ISSUE 3): done < 0 or beyond
@@ -54,9 +63,12 @@ class Heartbeat:
             eta = _fmt_hms(max(0.0, (self.total - done) / rate))
         else:
             eta = "?"
+        marker = ("" if self.committed is None else
+                  f" | committed {min(self.committed, self.total) if self.total else self.committed}"
+                  f"/{self.total}, resumable")
         return (f"HEARTBEAT {self.label}: {done}/{self.total} {self.unit}s "
                 f"({pct:.1f}%) | {rate:.2f} {self.unit}/s | "
-                f"elapsed {_fmt_hms(elapsed)} | ETA {eta}")
+                f"elapsed {_fmt_hms(elapsed)} | ETA {eta}{marker}")
 
     def beat(self, done_units: int, force: bool = False) -> str | None:
         """Log progress if ``interval_s`` elapsed since the last beat (or

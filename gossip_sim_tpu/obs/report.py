@@ -99,6 +99,7 @@ REQUIRED_KEYS = {
     "influx": dict,
     "stats": dict,
     "compilation_cache": dict,
+    "resilience": dict,
 }
 
 
@@ -228,6 +229,20 @@ def build_run_report(config, registry, *, stats: dict | None = None,
         "influx": dict(influx or {}),
         "stats": dict(stats or {}),
         "compilation_cache": _compilation_cache_section(info),
+        # resilient-execution accounting (resilience.py): journal units
+        # committed this run, units replayed from a prior run's journal,
+        # supervised dispatch failures and CPU-fallback re-executions —
+        # all zero for an undisturbed, unjournaled run
+        "resilience": {
+            "committed_units":
+                int(registry.counter("resilience/committed_units")),
+            "resumed_units":
+                int(registry.counter("resilience/resumed_units")),
+            "device_failures":
+                int(registry.counter("resilience/device_failures")),
+            "fallback_units":
+                int(registry.counter("resilience/fallback_units")),
+        },
     })
     return report
 
@@ -244,9 +259,24 @@ def _compilation_cache_section(info: dict) -> dict:
 
 
 def write_run_report(path: str, report: dict) -> None:
-    with open(path, "w") as f:
-        json.dump(report, f, indent=2, sort_keys=False)
-        f.write("\n")
+    """Atomic write (tmp + os.replace), matching checkpoint semantics: a
+    SIGKILL mid-write must never leave a truncated, unparseable report
+    where a previous good one stood."""
+    import os
+    import tempfile
+    payload = json.dumps(report, indent=2, sort_keys=False) + "\n"
+    fd, tmp = tempfile.mkstemp(prefix=".report-", suffix=".json",
+                               dir=os.path.dirname(path) or ".")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def validate_run_report(report: dict) -> list:

@@ -1,0 +1,654 @@
+"""Resilient execution layer: run journal, graceful shutdown, and the
+device-dispatch supervisor (ISSUE 7).
+
+The reference simulator loses everything on a preemption; PR 1-6 gave the
+single-origin path a state checkpoint but left every *multi-unit* path
+(serial sweeps, lane-batched sweeps, the batched origin-rank sweep,
+``--all-origins``) unable to resume.  This module supplies the three
+mechanisms cli.py composes into preemption-safe runs:
+
+* :class:`RunJournal` — an append-only JSONL journal next to the
+  checkpoint ``.npz``.  Each completed execution **unit** (one sim of a
+  serial sweep, one lane batch, one measured block of the origin-rank
+  sweep, one origin batch) commits a single self-contained record: the
+  unit's per-sim :meth:`~gossip_sim_tpu.stats.gossip_stats.GossipStats.
+  parity_snapshot`, the Influx line-protocol strings the unit pushed, and
+  the pubkey-counter position that reproduces the unit's cluster.  A
+  record is one ``json.dumps`` line flushed + fsynced; a SIGKILL mid-append
+  leaves at most one partial trailing line, which the loader drops — so a
+  journal is never unreadable and a committed record is never lost.
+  ``--resume`` replays committed records verbatim into stats/Influx
+  (deduplicated: replayed units are never recomputed or re-fed) and the
+  run restarts from the first uncommitted unit.
+
+* graceful shutdown — SIGTERM/SIGINT set a flag the run loops consult at
+  unit boundaries; the in-flight unit finishes its harvest, commits, and
+  the run exits with :data:`RESUMABLE_EXIT_CODE` (75, EX_TEMPFAIL) so a
+  supervisor script can distinguish "resume me" from a real failure.
+
+* :func:`supervised_call` — the device-dispatch watchdog.  An engine call
+  runs in a worker thread bounded by ``--device-timeout-s``; transient
+  XLA/runtime errors and timeouts are retried with exponential backoff,
+  and on exhaustion ``--on-device-failure cpu-fallback`` re-executes the
+  unit on the CPU backend (bit-compatible: the engine is deterministic
+  per device-independent integer math) while ``abort`` raises
+  :class:`DeviceDispatchError`, which cli.main converts into the
+  resumable exit code after committing the journal.
+
+Everything here is accelerator-agnostic; JAX is never imported at module
+scope.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import signal
+import threading
+import time
+
+from .obs import get_registry
+
+log = logging.getLogger(__name__)
+
+#: exit code of a run interrupted resumably (SIGTERM/SIGINT at a unit
+#: boundary, or --on-device-failure abort): EX_TEMPFAIL — "try again",
+#: distinct from 0 (done) and 1 (error).  A wrapper script can loop
+#: ``while run; rc=75; do run --resume; done``.
+RESUMABLE_EXIT_CODE = 75
+
+JOURNAL_SCHEMA = "gossip-sim-tpu/journal/v1"
+
+#: Config fields that shape a unit's content — two runs sharing these
+#: produce bit-identical units, so a journal written under one set must
+#: never be replayed under another.
+RUN_KEY_FIELDS = (
+    "gossip_push_fanout", "gossip_active_set_size", "gossip_iterations",
+    "origin_rank", "probability_of_rotation", "prune_stake_threshold",
+    "min_ingress_nodes", "filter_zero_staked_nodes", "fraction_to_fail",
+    "when_to_fail", "num_simulations", "warm_up_rounds",
+    "packet_loss_rate", "churn_fail_rate", "churn_recover_rate",
+    "partition_at", "heal_at", "gossip_mode", "pull_fanout",
+    "pull_interval", "pull_bloom_fp_rate", "pull_request_cap",
+    "backend", "seed", "num_synthetic_nodes", "account_file",
+    "sweep_lanes", "origin_batch",
+)
+
+
+class ResumableInterrupt(Exception):
+    """A graceful-shutdown request honored at a unit boundary: the journal
+    is committed up to and including the last finished unit and the run
+    should exit with :data:`RESUMABLE_EXIT_CODE`."""
+
+
+class DeviceTimeoutError(RuntimeError):
+    """A supervised device dispatch exceeded ``--device-timeout-s``."""
+
+
+class DeviceDispatchError(Exception):
+    """A supervised device dispatch failed beyond its retry budget under
+    ``--on-device-failure abort``.  The journal is already committed for
+    every earlier unit, so the run is resumable."""
+
+
+# --------------------------------------------------------------------------
+# run journal
+# --------------------------------------------------------------------------
+
+def journal_path(checkpoint_path: str) -> str:
+    """The journal file a checkpoint path implies (next to the state npz:
+    ``foo.npz`` -> ``foo.journal``; a bare ``foo`` -> ``foo.journal``)."""
+    base = checkpoint_path
+    if base.endswith(".npz"):
+        base = base[: -len(".npz")]
+    return base + ".journal"
+
+
+def run_key_from_config(config, kind: str, extra: dict | None = None) -> dict:
+    """The journal's run fingerprint: the Config fields that shape unit
+    content plus the unit ``kind`` (serial-sweep / lane-sweep /
+    origin-rank / all-origins).  ``extra`` carries per-path inputs that
+    live outside the Config — notably the full ``--origin-rank`` list,
+    of which Config holds only the first element."""
+    key = {f: getattr(config, f) for f in RUN_KEY_FIELDS}
+    key["test_type"] = str(config.test_type)
+    key["step_size"] = str(config.step_size)
+    key["kind"] = kind
+    if extra:
+        key.update(extra)
+    return key
+
+
+class RunJournal:
+    """Append-only unit journal (JSONL, one committed unit per line).
+
+    Line 0 is a header carrying the schema + run key; every further line
+    is ``{"unit": int, "payload": {...}}``.  ``commit`` appends, flushes
+    and fsyncs — the atomicity contract is line-granular: a torn write can
+    only produce a partial *last* line, which :meth:`_load` discards (with
+    a warning), never a corrupted earlier record.
+    """
+
+    def __init__(self, path: str, run_key: dict, resume: bool = False):
+        self.path = path
+        self.run_key = dict(run_key)
+        self.records: dict[int, dict] = {}
+        self._fh = None
+        existed = os.path.exists(path)
+        if resume and existed:
+            self._load()
+        elif existed:
+            log.warning("WARNING: overwriting existing journal %s (no "
+                        "--resume given); the prior run's committed units "
+                        "are discarded", path)
+        if not (resume and existed):
+            header = {"schema": JOURNAL_SCHEMA, "run_key": self.run_key,
+                      "pubkey_counter": _peek_pubkey_counter()}
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(path, "w") as f:
+                f.write(json.dumps(header) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    # -- loading ----------------------------------------------------------
+
+    def _load(self) -> None:
+        with open(self.path) as f:
+            lines = f.read().splitlines()
+        if not lines:
+            raise SystemExit(f"ERROR: journal {self.path} is empty — "
+                             f"remove it to start fresh")
+        header = self._parse(lines[0], 0)
+        if header is None or header.get("schema") != JOURNAL_SCHEMA:
+            raise SystemExit(
+                f"ERROR: {self.path} is not a "
+                f"{JOURNAL_SCHEMA} journal — remove it to start fresh")
+        stored_key = header.get("run_key", {})
+        drift = {k: (stored_key.get(k), self.run_key[k])
+                 for k in self.run_key
+                 if stored_key.get(k) != self.run_key[k]}
+        if drift:
+            raise SystemExit(
+                "ERROR: --resume run configuration does not match the "
+                "journal's: " + ", ".join(
+                    f"{k}: journal={a!r} vs now={b!r}"
+                    for k, (a, b) in sorted(drift.items()))
+                + f". Remove {self.path} to start fresh.")
+        self.header = header
+        valid_bytes = len(lines[0].encode()) + 1
+        for i, line in enumerate(lines[1:], start=1):
+            rec = self._parse(line, i)
+            if rec is None:
+                if i != len(lines) - 1:
+                    log.warning("WARNING: journal %s line %s is corrupt; "
+                                "units from there on are treated as "
+                                "uncommitted", self.path, i)
+                else:
+                    log.warning("WARNING: journal %s ends in a partial "
+                                "record (killed mid-commit); the unit is "
+                                "treated as uncommitted", self.path)
+                # truncate the torn tail so later commits append complete
+                # lines instead of gluing onto the partial one
+                with open(self.path, "r+") as f:
+                    f.truncate(valid_bytes)
+                break
+            valid_bytes += len(line.encode()) + 1
+            self.records[int(rec["unit"])] = rec.get("payload", {})
+
+    @staticmethod
+    def _parse(line: str, i: int):
+        try:
+            return json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            return None
+
+    # -- committing -------------------------------------------------------
+
+    def commit(self, unit: int, payload: dict) -> None:
+        """Durably commit one finished unit (flush + fsync)."""
+        if self._fh is None:
+            self._fh = open(self.path, "a")
+        rec = {"unit": int(unit), "payload": payload}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self.records[int(unit)] = payload
+        get_registry().add("resilience/committed_units", 1)
+        note_unit_committed()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # -- resume accounting ------------------------------------------------
+
+    def committed_prefix(self) -> int:
+        """Number of consecutive units [0, k) already committed — resume
+        restarts at unit k (units commit in order, so holes cannot occur
+        in a healthy journal but are tolerated defensively)."""
+        k = 0
+        while k in self.records:
+            k += 1
+        return k
+
+    def header_pubkey_counter(self) -> int | None:
+        """The counter position recorded when the journal was created
+        (before the first cluster load) — resume restores it so synthetic
+        clusters draw the same pubkeys.  The serial-sweep path needs no
+        per-unit positions: replaying a unit re-loads its cluster, which
+        advances the counter exactly as the live sim did."""
+        hdr = getattr(self, "header", None)
+        if hdr is None:
+            return None
+        v = hdr.get("pubkey_counter")
+        return int(v) if v is not None else None
+
+
+def _peek_pubkey_counter() -> int:
+    from .identity import peek_unique_pubkeys
+    return peek_unique_pubkeys()
+
+
+def restore_pubkey_counter(value) -> None:
+    """Replay-time counter restore: later units of a resumed run must see
+    the same ``pubkey_new_unique`` stream an uninterrupted run would
+    (synthetic clusters draw their pubkeys from it)."""
+    if value is None:
+        return
+    from .identity import reset_unique_pubkeys
+    reset_unique_pubkeys(int(value))
+
+
+# --------------------------------------------------------------------------
+# parity-snapshot (de)serialization + stats restoration
+# --------------------------------------------------------------------------
+
+def snapshot_to_jsonable(snap: dict) -> dict:
+    """A ``GossipStats.parity_snapshot()`` as plain JSON types: pubkeys
+    become their base58 strings, the failed set a sorted list.  Exact —
+    Python json round-trips floats via repr and ints unbounded."""
+    out = {}
+    for k, v in snap.items():
+        if k == "stranded":
+            out[k] = {pk.to_string(): [int(s), int(c)]
+                      for pk, (s, c) in v.items()}
+        elif k in ("egress", "ingress", "prunes"):
+            out[k] = {pk.to_string(): int(n) for pk, n in v.items()}
+        elif k == "failed_nodes":
+            out[k] = sorted(pk.to_string() for pk in v)
+        else:
+            out[k] = v
+    return out
+
+
+def snapshot_from_jsonable(d: dict) -> dict:
+    """Inverse of :func:`snapshot_to_jsonable` — returns a dict comparable
+    key-for-key with a freshly-computed parity snapshot."""
+    from .identity import Pubkey
+    out = {}
+    for k, v in d.items():
+        if k == "stranded":
+            out[k] = {Pubkey.from_string(s): (vals[0], vals[1])
+                      for s, vals in v.items()}
+        elif k in ("egress", "ingress", "prunes"):
+            out[k] = {Pubkey.from_string(s): n for s, n in v.items()}
+        elif k == "failed_nodes":
+            out[k] = {Pubkey.from_string(s) for s in v}
+        else:
+            out[k] = v
+    return out
+
+
+def stats_unit_payload(stats) -> dict:
+    """One sim's journal payload: the canonical parity snapshot plus the
+    non-snapshot state a bit-exact continuation needs (per-round hop
+    maxima for LDH, the post-heal coverage series, the origin)."""
+    return {
+        "origin": stats.origin.to_string() if stats.origin else "",
+        "snapshot": snapshot_to_jsonable(stats.parity_snapshot()),
+        "hops_round_max": [int(s.max)
+                           for s in stats.hops_stats.per_round_stats],
+        "post_heal": [[it, cov] for it, cov in stats._post_heal_coverage],
+    }
+
+
+def restore_stats(payload: dict, config, stakes):
+    """Rebuild a :class:`GossipStats` from a journal payload.
+
+    The restored object reproduces ``parity_snapshot()`` exactly and — for
+    the stats layer's end-of-run outputs — restores every series the
+    histogram builders and ``run_all_calculations`` consume.  Per-round
+    ``HopsStat``/``StrandedNodeStats`` entries are rebuilt as placeholders
+    carrying exactly what later consumers read (the hop ``max`` feeding
+    last-delivery-hop stats); their per-iteration mean/median fed Influx
+    at capture time and those lines are replayed verbatim, never
+    recomputed."""
+    from .constants import VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS
+    from .identity import Pubkey
+    from .stats.gossip_stats import GossipStats
+    from .stats.hops import HopsStat
+    from .stats.stranded import StrandedNodeStats
+
+    snap = snapshot_from_jsonable(payload["snapshot"])
+    stats = GossipStats()
+    stats.set_simulation_parameters(config)
+    if payload.get("origin"):
+        stats.set_origin(Pubkey.from_string(payload["origin"]))
+    stats.initialize_message_stats(stakes)
+    stats.build_validator_stake_distribution_histogram(
+        VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS, stakes)
+
+    stats.coverage_stats.collection = list(snap["coverage"])
+    stats.rmr_stats.collection = list(snap["rmr"])
+    stats.outbound_branching_factors.collection = list(snap["branching"])
+    stats.hops_stats.raw_hop_collection = list(snap["hops"])
+    for m in payload.get("hops_round_max", []):
+        h = HopsStat()
+        h.max = m
+        stats.hops_stats.per_round_stats.append(h)
+    sc = stats.stranded_node_collection
+    sc.stranded_nodes = dict(snap["stranded"])
+    sc.total_gossip_iterations = len(snap["coverage"])
+    sc.total_nodes = len(stakes)
+    sc.per_iter_stats = [StrandedNodeStats()
+                         for _ in range(len(snap["coverage"]))]
+    stats.egress_messages.counts = dict(snap["egress"])
+    stats.ingress_messages.counts = dict(snap["ingress"])
+    stats.prune_messages.counts = dict(snap["prunes"])
+    stats.delivered_stats.collection = list(snap["delivered"])
+    stats.dropped_stats.collection = list(snap["dropped"])
+    stats.suppressed_stats.collection = list(snap["suppressed"])
+    stats.failed_count_series = list(snap["failed_count_series"])
+    stats.failed_nodes = set(snap["failed_nodes"])
+    stats.pull_requests_stats.collection = list(snap["pull_requests"])
+    stats.pull_responses_stats.collection = list(snap["pull_responses"])
+    stats.pull_misses_stats.collection = list(snap["pull_misses"])
+    stats.pull_dropped_stats.collection = list(snap["pull_dropped"])
+    stats.pull_suppressed_stats.collection = list(snap["pull_suppressed"])
+    stats.pull_rescued_stats.collection = list(snap["pull_rescued"])
+    stats.recovery_iterations = snap["recovery_iterations"]
+    stats._post_heal_coverage = [(int(it), float(cov))
+                                 for it, cov in payload.get("post_heal", [])]
+    return stats
+
+
+# --------------------------------------------------------------------------
+# influx capture / replay
+# --------------------------------------------------------------------------
+
+class InfluxTee:
+    """A :class:`~gossip_sim_tpu.sinks.DatapointQueue` facade that records
+    every pushed point's line-protocol body into the current unit's buffer
+    while forwarding to the real queue.  ``take_unit_lines`` hands the
+    buffer to the journal commit and resets it for the next unit."""
+
+    def __init__(self, queue):
+        self.queue = queue
+        self._lines: list[str] = []
+
+    def push_back(self, dp) -> None:
+        self._lines.append(dp.data())
+        self.queue.push_back(dp)
+
+    def __len__(self):
+        return len(self.queue)
+
+    def take_unit_lines(self) -> list:
+        lines, self._lines = self._lines, []
+        return lines
+
+
+def replay_influx_lines(dp_queue, lines) -> None:
+    """Push journaled line-protocol bodies back onto the live queue
+    verbatim — original per-point timestamps included, so the replayed
+    wire payload is byte-identical to what the interrupted run emitted
+    (and an Influx endpoint that already received them deduplicates on
+    the identical series+timestamp)."""
+    if dp_queue is None or not lines:
+        return
+    from .sinks import InfluxDataPoint
+    for body in lines:
+        dp = InfluxDataPoint()
+        dp.datapoint = body
+        dp_queue.push_back(dp)
+
+
+# --------------------------------------------------------------------------
+# graceful shutdown
+# --------------------------------------------------------------------------
+
+_shutdown_event = threading.Event()
+_units_this_run = 0
+_kill_after_units = 0
+
+#: env hook for tools/resume_smoke.py: SIGTERM self after N commits so the
+#: kill lands deterministically at a unit boundary's far side (the signal
+#: path itself — handler, flag, commit, exit code — is what's under test)
+KILL_AFTER_ENV = "GOSSIP_RESILIENCE_KILL_AFTER_UNITS"
+
+
+def reset_shutdown() -> None:
+    """Clear shutdown state (one process == one run; cli.main calls this
+    on entry so a previous in-process run's interrupt can't leak)."""
+    global _units_this_run, _kill_after_units
+    _shutdown_event.clear()
+    _units_this_run = 0
+    _kill_after_units = int(os.environ.get(KILL_AFTER_ENV, "0") or 0)
+
+
+def request_shutdown() -> None:
+    """Programmatic SIGTERM equivalent (tests + the kill-after hook)."""
+    _shutdown_event.set()
+
+
+def shutdown_requested() -> bool:
+    return _shutdown_event.is_set()
+
+
+def set_kill_after_units(n: int) -> None:
+    """Test hook: request shutdown after ``n`` journal commits."""
+    global _kill_after_units
+    _kill_after_units = int(n)
+
+
+def note_unit_committed() -> None:
+    global _units_this_run
+    _units_this_run += 1
+    if _kill_after_units and _units_this_run >= _kill_after_units:
+        if _signal_handlers_installed():
+            os.kill(os.getpid(), signal.SIGTERM)
+        else:
+            request_shutdown()
+
+
+_handlers_installed = threading.Event()
+
+
+def _signal_handlers_installed() -> bool:
+    return _handlers_installed.is_set()
+
+
+@contextlib.contextmanager
+def signal_guard():
+    """Install SIGTERM/SIGINT handlers that request a graceful, resumable
+    shutdown.  A second SIGINT falls through to the previous handler
+    (KeyboardInterrupt) so an operator can still hard-stop.  No-op when
+    not on the main thread (signal.signal would raise)."""
+    prev = {}
+    try:
+        def _handler(signum, frame):
+            if signum == signal.SIGINT and shutdown_requested():
+                raise KeyboardInterrupt
+            log.warning(
+                "received signal %s: finishing the in-flight unit, "
+                "committing the journal, and exiting with the resumable "
+                "exit code %s", signum, RESUMABLE_EXIT_CODE)
+            _shutdown_event.set()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig, _handler)
+        _handlers_installed.set()
+    except ValueError:  # not the main thread — run unguarded
+        prev = {}
+    try:
+        yield
+    finally:
+        _handlers_installed.clear()
+        for sig, h in prev.items():
+            try:
+                signal.signal(sig, h)
+            except ValueError:  # pragma: no cover
+                pass
+
+
+def check_interrupt(journal=None) -> None:
+    """Unit-boundary shutdown check: raise :class:`ResumableInterrupt`
+    when a graceful shutdown was requested (the caller's finished units
+    are already committed)."""
+    if shutdown_requested():
+        raise ResumableInterrupt(
+            "graceful shutdown at a unit boundary"
+            + (f" ({len(journal.records)} unit(s) committed)"
+               if journal is not None else ""))
+
+
+# --------------------------------------------------------------------------
+# device-dispatch supervisor
+# --------------------------------------------------------------------------
+
+_fault_hook = None
+
+
+def set_fault_hook(fn) -> None:
+    """Install a test fault injector called as ``fn(label, attempt)``
+    before every supervised dispatch attempt; raising from it simulates a
+    device failure.  ``None`` uninstalls.  Installing a hook also turns
+    supervision on for runs that didn't opt in via flags, so tests can
+    exercise the retry path without a watchdog timeout."""
+    global _fault_hook
+    _fault_hook = fn
+
+
+class DispatchPolicy:
+    """Resolved watchdog knobs for one run (see cli flags)."""
+
+    def __init__(self, timeout_s: float = 0.0, retries: int = 2,
+                 backoff_s: float = 0.5, on_failure: str = "abort"):
+        self.timeout_s = float(timeout_s)
+        self.retries = max(0, int(retries))
+        self.backoff_s = float(backoff_s)
+        self.on_failure = on_failure
+
+
+def supervision(config) -> DispatchPolicy | None:
+    """The dispatch policy a Config opts into, or None (unsupervised —
+    the zero-overhead default).  Supervision turns on when a watchdog
+    timeout is set, when ``--on-device-failure`` was passed explicitly,
+    or when a test fault hook is installed."""
+    timeout = getattr(config, "device_timeout_s", 0.0)
+    on_failure = getattr(config, "on_device_failure", "")
+    if timeout <= 0 and not on_failure and _fault_hook is None:
+        return None
+    return DispatchPolicy(timeout_s=timeout,
+                          retries=getattr(config, "device_retries", 2),
+                          # not a CLI flag: tests set it on the Config
+                          # instance to skip real backoff sleeps
+                          backoff_s=getattr(config, "device_backoff_s", 0.5),
+                          on_failure=on_failure or "abort")
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Retryable device/runtime failures: XLA runtime errors surface as
+    jaxlib ``XlaRuntimeError`` (a RuntimeError subclass in recent JAX) or
+    plain RuntimeError/OSError; watchdog timeouts are transient by
+    definition.  Programming errors (TypeError, ValueError, shape
+    mismatches) are not retried — re-running wrong code is not
+    resilience."""
+    if isinstance(exc, (NotImplementedError, RecursionError)):
+        # RuntimeError subclasses that are deterministic programming
+        # errors, not device flakes
+        return False
+    if isinstance(exc, (DeviceTimeoutError, TimeoutError, OSError,
+                        ConnectionError, RuntimeError)):
+        return True
+    return "XlaRuntimeError" in type(exc).__name__
+
+
+def _call_with_timeout(fn, timeout_s: float, label: str):
+    """Run ``fn`` bounded by ``timeout_s`` (<= 0: unbounded, in-thread).
+
+    The watchdog thread is daemonic and abandoned on timeout — a truly
+    hung device call cannot be cancelled from Python, only outwaited; the
+    supervisor's job is to get the *run* unstuck (retry or CPU fallback),
+    not to reclaim the wedged dispatch."""
+    if timeout_s <= 0:
+        return fn()
+    result: list = []
+    error: list = []
+
+    def _worker():
+        try:
+            result.append(fn())
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            error.append(e)
+
+    t = threading.Thread(target=_worker, daemon=True,
+                         name=f"device-dispatch:{label}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise DeviceTimeoutError(
+            f"device dispatch '{label}' exceeded --device-timeout-s "
+            f"{timeout_s}")
+    if error:
+        raise error[0]
+    return result[0]
+
+
+def supervised_call(label: str, attempt_fn, policy: DispatchPolicy,
+                    cpu_fallback=None):
+    """Run one engine unit under the watchdog/retry/fallback policy.
+
+    ``attempt_fn`` must be safe to call repeatedly (cli rebuilds donated
+    device state from a host snapshot per attempt).  Transient failures
+    are retried ``policy.retries`` times with exponential backoff and
+    counted in the ``resilience/device_failures`` registry counter; on
+    exhaustion ``cpu-fallback`` invokes ``cpu_fallback`` (counted in
+    ``resilience/fallback_units``) while ``abort`` raises
+    :class:`DeviceDispatchError`."""
+    reg = get_registry()
+    delay = policy.backoff_s
+    last = None
+    for attempt in range(policy.retries + 1):
+        try:
+            if _fault_hook is not None:
+                _fault_hook(label, attempt)
+            return _call_with_timeout(attempt_fn, policy.timeout_s, label)
+        except BaseException as e:  # noqa: BLE001 - classified below
+            if not _is_transient(e):
+                raise
+            last = e
+            reg.add("resilience/device_failures", 1)
+            if attempt < policy.retries:
+                log.warning("device dispatch '%s' failed (attempt %s/%s): "
+                            "%s — retrying in %.2fs", label, attempt + 1,
+                            policy.retries + 1, e, delay)
+                time.sleep(delay)
+                delay *= 2
+    if policy.on_failure == "cpu-fallback" and cpu_fallback is not None:
+        log.warning("device dispatch '%s' failed %s attempt(s); "
+                    "re-executing the unit on the CPU fallback path",
+                    label, policy.retries + 1)
+        reg.add("resilience/fallback_units", 1)
+        # the fault hook injects *device* failures; the fallback arm runs
+        # clean, as a healthy CPU re-execution would
+        return cpu_fallback()
+    raise DeviceDispatchError(
+        f"device dispatch '{label}' failed after {policy.retries + 1} "
+        f"attempt(s) ({last}); the journal holds every earlier unit — "
+        f"re-run with --resume") from last

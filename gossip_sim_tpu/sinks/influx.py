@@ -345,7 +345,8 @@ class InfluxDB:
     def __init__(self, endpoint: str, username: str, password: str,
                  database: str, tracker: Tracker | None = None,
                  timeout: float = 10.0, max_retries: int = 3,
-                 retry_base: float = 0.5, max_queue: int = 1024):
+                 retry_base: float = 0.5, max_queue: int = 1024,
+                 spool_path: str = ""):
         self.url = endpoint.rstrip("/") + "/write"
         self.database = database
         self.username = username
@@ -355,15 +356,42 @@ class InfluxDB:
         self.max_retries = max_retries
         self.retry_base = retry_base
         self.max_queue = max_queue
+        self.spool_path = spool_path  # durable on-disk line-protocol spool
         self.dropped_points = 0   # points lost after retries / queue overflow
+        self.spooled_points = 0   # points diverted to the spool file
         self.points_sent = 0      # points acknowledged 2xx by the endpoint
         self.retry_count = 0      # transient-failure retries attempted
         self._send_q = None
         self._send_lock = threading.Lock()
+        self._spool_lock = threading.Lock()
 
-    def _count_dropped(self):
+    def _count_dropped(self, body: str | None = None):
+        """A point exhausted its retries (or the queue overflowed): spool
+        it durably when --influx-spool is configured — the point keeps its
+        original per-point timestamps, so tools/influx_replay.py re-sends
+        exactly what the run would have written — else count it lost."""
+        if body and self.spool_path and self._spool(body):
+            with self._send_lock:
+                self.spooled_points += 1
+            return
         with self._send_lock:
             self.dropped_points += 1
+
+    def _spool(self, body: str) -> bool:
+        """Append one point's line-protocol body to the spool file.
+        Append-mode writes of a single buffered payload are atomic enough
+        for line protocol (the replayer skips any torn final line).
+        Returns False — falling back to the dropped count — if the spool
+        itself is unwritable."""
+        try:
+            with self._spool_lock:
+                with open(self.spool_path, "a") as f:
+                    f.write(body if body.endswith("\n") else body + "\n")
+            return True
+        except OSError as err:
+            log.error("influx spool %s unwritable (%s); counting point "
+                      "as dropped", self.spool_path, err)
+            return False
 
     def sender_stats(self) -> dict:
         """Delivery accounting for end-of-run logging and the run report."""
@@ -371,6 +399,7 @@ class InfluxDB:
             return {
                 "points_sent": self.points_sent,
                 "dropped_points": self.dropped_points,
+                "spooled_points": self.spooled_points,
                 "retries": self.retry_count,
             }
 
@@ -417,9 +446,10 @@ class InfluxDB:
                     time.sleep(delay * (1.0 + 0.5 * random.random()))
                     delay *= 2
                 else:
-                    self._count_dropped()
-                    log.error("Dropping InfluxDB point after %s attempt(s): "
-                              "%s", attempt + 1, err)
+                    self._count_dropped(body)
+                    log.error("%s InfluxDB point after %s attempt(s): %s",
+                              "Spooling" if self.spool_path else "Dropping",
+                              attempt + 1, err)
                     return
         finally:
             if self.tracker is not None:
@@ -452,12 +482,13 @@ class InfluxDB:
         try:
             self._send_q.put_nowait(datapoint.data())
         except queue.Full:
-            self._count_dropped()
+            self._count_dropped(datapoint.data())
             # still mark it sent: the drain tracker must converge
             if self.tracker is not None:
                 self.tracker.add_sent()
-            log.error("InfluxDB send queue full (%s); dropping point",
-                      self.max_queue)
+            log.error("InfluxDB send queue full (%s); %s point",
+                      self.max_queue,
+                      "spooling" if self.spool_path else "dropping")
 
 
 class InfluxThread:
@@ -469,10 +500,11 @@ class InfluxThread:
     burying it in the drain log."""
 
     def __init__(self, endpoint: str, username: str, password: str,
-                 database: str, datapoint_queue: DatapointQueue):
+                 database: str, datapoint_queue: DatapointQueue,
+                 spool_path: str = ""):
         self.tracker = Tracker()
         self.db = InfluxDB(endpoint, username, password, database,
-                           self.tracker)
+                           self.tracker, spool_path=spool_path)
         self._queue = datapoint_queue
         self._thread: threading.Thread | None = None
 
@@ -501,6 +533,12 @@ class InfluxThread:
                         log.warning("WARNING: %s InfluxDB point(s) dropped "
                                     "(send failures after retries or queue "
                                     "overflow)", self.db.dropped_points)
+                    if self.db.spooled_points:
+                        log.warning("WARNING: %s InfluxDB point(s) spooled "
+                                    "to %s; re-send with "
+                                    "tools/influx_replay.py",
+                                    self.db.spooled_points,
+                                    self.db.spool_path)
                     log.info("Queue Drained. Exiting...")
                     break
             time.sleep(wait_time)
@@ -525,11 +563,14 @@ class InfluxThread:
 
     @staticmethod
     def spawn(endpoint: str, username: str, password: str, database: str,
-              datapoint_queue: DatapointQueue) -> "InfluxThread":
+              datapoint_queue: DatapointQueue,
+              spool_path: str = "") -> "InfluxThread":
         """Run the loop in a daemon thread; returns the join-able handle
-        (the reference's std::thread::spawn, gossip_main.rs:746-768)."""
+        (the reference's std::thread::spawn, gossip_main.rs:746-768).
+        ``spool_path`` diverts retry-exhausted / overflow points to a
+        durable line-protocol spool (tools/influx_replay.py re-sends)."""
         it = InfluxThread(endpoint, username, password, database,
-                          datapoint_queue)
+                          datapoint_queue, spool_path=spool_path)
         it._thread = threading.Thread(target=it.run, daemon=True)
         it._thread.start()
         return it

@@ -220,6 +220,46 @@ class AllOriginsStats:
         self.measured_points += int(cov.size)
         self.num_origins += int(np.asarray(rows["coverage"]).shape[-1])
 
+    # -- resumable snapshot (resilience.py sidecar) -----------------------
+
+    _SCALAR_STATE = ("measured_points", "num_origins", "inb_dropped",
+                     "rc_overflow", "hop_clamped", "total_dropped",
+                     "total_suppressed", "total_pull_requests",
+                     "total_pull_responses", "total_pull_rescued",
+                     "total_pull_dropped", "total_pull_suppressed")
+    _ARRAY_STATE = ("hops_hist", "stranded_counts", "egress", "ingress",
+                    "prunes", "pull_hops_hist", "pull_rescued_counts")
+
+    def state_dict(self) -> dict:
+        """Everything ``add_batch`` has accumulated, as npz-ready arrays.
+        The all-origins journal (cli.run_all_origins) snapshots this after
+        each committed origin batch; ``load_state_dict`` + the remaining
+        batches reproduce an uninterrupted run exactly — the per-point
+        chunks concatenate to the same series ``finalize`` would see."""
+        out = {}
+        for f in self._SCALAR_STATE:
+            out["scalar." + f] = np.int64(getattr(self, f))
+        for f in self._ARRAY_STATE:
+            out["array." + f] = np.asarray(getattr(self, f))
+        out["array.recovery_iters"] = np.asarray(self.recovery_iters,
+                                                 np.int64)
+        for k, chunks in self._chunks.items():
+            dtype = np.int64 if k == "ldh" else np.float64
+            out["chunk." + k] = (np.concatenate(chunks) if chunks
+                                 else np.empty(0, dtype))
+        return out
+
+    def load_state_dict(self, sd: dict) -> None:
+        for f in self._SCALAR_STATE:
+            setattr(self, f, int(sd["scalar." + f]))
+        for f in self._ARRAY_STATE:
+            setattr(self, f, np.asarray(sd["array." + f]))
+        self.recovery_iters = [int(v)
+                               for v in np.asarray(sd["array.recovery_iters"])]
+        for k in self._chunks:
+            arr = np.asarray(sd["chunk." + k])
+            self._chunks[k] = [arr] if arr.size else []
+
     # -- end-of-run -------------------------------------------------------
 
     @staticmethod

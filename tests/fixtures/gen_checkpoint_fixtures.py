@@ -1,0 +1,87 @@
+"""Generator for the committed v1-v4 checkpoint fixtures (run once).
+
+The fixtures pin the forward-compat contract: every checkpoint format the
+project ever shipped must stay loadable by ``load_state`` /
+``restore_sim_state`` forever (tests/test_checkpoint.py matrix).  They
+are COMMITTED BINARIES — regenerating them with a newer engine would
+defeat the point, so this script exists only to document how they were
+made (v5-era engine, 2026-08) and to rebuild them if the fixture cluster
+spec itself ever has to change (requires re-validating against the old
+loaders).
+
+Each fixture holds:
+  * ``state.*``      — SimState arrays after 3 rounds on a 16-node seeded
+                       cluster, stripped down to the fields that existed
+                       in that format era
+  * ``__meta__``     — the era's meta block (format_version, params dict
+                       without the fields later eras added)
+  * ``fixture.stakes`` — the cluster stakes, so the matrix test can
+                       rebuild the exact ClusterTables without depending
+                       on the synthetic-account generator's stability
+
+Usage: JAX_PLATFORMS=cpu python tests/fixtures/gen_checkpoint_fixtures.py
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "checkpoints")
+
+# fields each era's SimState did NOT yet have
+V1_MISSING = ("tfail", "rc_shi", "rc_slo",
+              "pull_hops_hist_acc", "pull_rescued_acc")
+PRE_V4_MISSING = ("pull_hops_hist_acc", "pull_rescued_acc")
+IMPAIR_KEYS = ("packet_loss_rate", "churn_fail_rate", "churn_recover_rate",
+               "partition_at", "heal_at", "impair_seed")
+PULL_KEYS = ("gossip_mode", "pull_fanout", "pull_interval",
+             "pull_bloom_fp_rate", "pull_request_cap", "pull_slots")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp  # noqa: F401 - engine import side effects
+
+    from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                       make_cluster_tables, run_rounds)
+
+    os.makedirs(HERE, exist_ok=True)
+    rng = np.random.default_rng(42)
+    stakes = rng.integers(1, 1 << 16, 16).astype(np.int64) * 1_000_000_000
+    tables = make_cluster_tables(stakes)
+    params = EngineParams(num_nodes=16, warm_up_rounds=0)
+    origins = jnp.arange(1, dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(7), tables, origins, params)
+    state, _ = run_rounds(params, tables, origins, state, 3)
+    arrays = {f"state.{f}": np.asarray(getattr(state, f))
+              for f in state._fields}
+    pdict = dict(params._asdict())
+
+    def write(version, drop_fields, drop_params, meta_extra):
+        arrs = {k: v for k, v in arrays.items()
+                if k[len("state."):] not in drop_fields}
+        p = {k: v for k, v in pdict.items() if k not in drop_params}
+        meta = {"format_version": version, "params": p, "iteration": 3}
+        meta.update(meta_extra)
+        path = os.path.join(HERE, f"v{version}.npz")
+        np.savez_compressed(
+            path, __meta__=np.frombuffer(json.dumps(meta).encode(),
+                                         dtype=np.uint8),
+            **{"fixture.stakes": stakes}, **arrs)
+        print(f"wrote {path} ({len(arrs)} state arrays)")
+
+    impair = {k: pdict[k] for k in IMPAIR_KEYS}
+    pull = {k: pdict[k] for k in PULL_KEYS if k != "pull_slots"}
+    write(1, V1_MISSING, IMPAIR_KEYS + PULL_KEYS, {})
+    write(2, PRE_V4_MISSING, IMPAIR_KEYS + PULL_KEYS, {})
+    write(3, PRE_V4_MISSING, PULL_KEYS, {"impair": impair})
+    write(4, (), (), {"impair": impair, "pull": pull})
+
+
+if __name__ == "__main__":
+    main()

@@ -113,7 +113,7 @@ def test_v3_checkpoint_records_impair_block(tmp_path):
     path = str(tmp_path / "ckpt.npz")
     save_state(path, state, params, iteration=4)
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 4
+    assert meta["format_version"] == 5
     assert meta["impair"] == {
         "packet_loss_rate": 0.25, "churn_fail_rate": 0.01,
         "churn_recover_rate": 0.5, "partition_at": 3, "heal_at": 8,
@@ -231,6 +231,68 @@ def test_impair_knob_mismatch_warns_on_resume(tmp_path, caplog):
     with caplog.at_level(logging.WARNING):
         restore_sim_state(path, saved._replace(packet_loss_rate=0.4))
     assert any("impairment schedule" in r.message for r in caplog.records)
+
+
+FIXTURE_DIR = __file__.rsplit("/", 1)[0] + "/fixtures/checkpoints"
+
+
+@pytest.mark.parametrize("version", [1, 2, 3, 4])
+def test_checkpoint_forward_compat_matrix(version):
+    """Committed v1-v4 fixture files (tests/fixtures/checkpoints, frozen
+    binaries from each format era) must load and restore forever — v5 can
+    never silently orphan old checkpoints (ISSUE 7).  Each fixture must
+    (a) pass load_state's validation against current EngineParams,
+    (b) restore to a full SimState with the era-appropriate backfills,
+    (c) continue running on the current engine."""
+    import json
+
+    from gossip_sim_tpu.checkpoint import load_state
+
+    path = f"{FIXTURE_DIR}/v{version}.npz"
+    with np.load(path) as z:
+        stakes = z["fixture.stakes"]
+        meta_raw = json.loads(bytes(z["__meta__"]).decode())
+    assert meta_raw["format_version"] == version
+    tables = make_cluster_tables(stakes.astype(np.int64))
+    params = EngineParams(num_nodes=16, warm_up_rounds=0)
+
+    arrays, stored, meta = load_state(path, params)
+    assert stored["num_nodes"] == 16
+    # era backfills: pre-v3 impair all-off, pre-v4 pull mode "push",
+    # pre-v5 resilience block empty
+    if version < 3:
+        assert meta["impair"]["packet_loss_rate"] == 0.0
+        assert meta["impair"]["partition_at"] == -1
+    if version < 4:
+        assert meta["pull"]["gossip_mode"] == "push"
+    assert meta["resilience"] == {}
+
+    restored, _, _ = restore_sim_state(path, params, tables)
+    for f in restored._fields:
+        assert np.asarray(getattr(restored, f)).size >= 0, f
+    if version == 1:
+        # derived-field backfill must have produced real arrays
+        assert np.asarray(restored.tfail).shape[-1] > 0
+    if version < 4:
+        assert (np.asarray(restored.pull_hops_hist_acc) == 0).all()
+        assert (np.asarray(restored.pull_rescued_acc) == 0).all()
+    # the restored state must continue on the current engine
+    origins = jnp.arange(1, dtype=jnp.int32)
+    state, rows = run_rounds(params, tables, origins, restored, 2,
+                             start_it=int(meta.get("iteration", 3)),
+                             detail=True)
+    assert np.asarray(rows["coverage"]).shape[0] == 2
+
+
+def test_v5_checkpoint_records_resilience_block(tmp_path):
+    params, tables, origins, state = _setup()
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, params, iteration=2,
+               resilience={"journal": "ckpt.journal", "committed_units": 3})
+    _, _, meta = restore_sim_state(path, params)
+    assert meta["format_version"] == 5
+    assert meta["resilience"] == {"journal": "ckpt.journal",
+                                  "committed_units": 3}
 
 
 def test_cli_kill_and_resume_bit_identical(tmp_path):

@@ -2,6 +2,7 @@
 reporter thread's start/end-sentinel drain loop (influx_db.rs:146-204)."""
 
 import http.server
+import os
 import threading
 import time
 
@@ -307,6 +308,69 @@ def test_post_drops_point_after_retries_exhausted():
                   max_retries=1, retry_base=0.01)
     db._post("coverage data=1.0 1\n")
     assert db.dropped_points == 1
+
+
+def test_retry_exhaustion_spools_point_durably(tmp_path):
+    """--influx-spool (ISSUE 7): a retry-exhausted point is appended to
+    the on-disk line-protocol spool — original timestamps intact — and
+    counted as spooled, not dropped."""
+    from gossip_sim_tpu.sinks.influx import InfluxDB
+
+    spool = str(tmp_path / "points.spool")
+    db = InfluxDB("http://127.0.0.1:9", "u", "p", "db",
+                  max_retries=1, retry_base=0.01, spool_path=spool)
+    db._post("coverage data=1.0 123456789\n")
+    db._post("rmr rmr=5.0,m=1,n=2 123456790\n")
+    stats = db.sender_stats()
+    assert stats["spooled_points"] == 2
+    assert stats["dropped_points"] == 0
+    lines = open(spool).read().splitlines()
+    assert lines == ["coverage data=1.0 123456789",
+                     "rmr rmr=5.0,m=1,n=2 123456790"]
+
+
+def test_queue_overflow_spools_and_tracker_converges(tmp_path):
+    from gossip_sim_tpu.sinks.influx import InfluxDB, Tracker
+
+    spool = str(tmp_path / "overflow.spool")
+    tracker = Tracker()
+    db = InfluxDB("http://127.0.0.1:9", "u", "p", "db", tracker=tracker,
+                  max_retries=0, retry_base=0.01, max_queue=2,
+                  spool_path=spool)
+    for i in range(8):
+        dp = InfluxDataPoint("1", 0)
+        dp.create_data_point(float(i), "coverage")
+        db.send_data_points(dp)
+        tracker.add_dequeued()
+    deadline = time.time() + 30
+    while not tracker.equal() and time.time() < deadline:
+        time.sleep(0.05)
+    assert tracker.equal(), "drain tracker failed to converge"
+    stats = db.sender_stats()
+    assert stats["spooled_points"] >= 6
+    assert stats["dropped_points"] == 0
+    assert len(open(spool).read().splitlines()) == stats["spooled_points"]
+
+
+def test_influx_replay_tool_parses_spool(tmp_path):
+    """tools/influx_replay.py --dry-run: counts valid point lines and
+    skips a torn final line (killed mid-append)."""
+    import subprocess
+    import sys as _sys
+
+    spool = tmp_path / "replay.spool"
+    spool.write_text("coverage data=1.0 123456789\n"
+                     "rmr rmr=5.0,m=1,n=2 123456790\n"
+                     "stranded_node_stats count=3 torn-timesta")
+    out = subprocess.run(
+        [_sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "influx_replay.py"),
+         str(spool), "--dry-run"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "2 point line(s)" in out.stdout
+    assert "torn/invalid" in out.stdout
 
 
 def test_bounded_send_queue_sheds_points_and_tracker_converges():

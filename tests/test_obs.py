@@ -333,7 +333,8 @@ def test_influx_thread_exposes_sender_stats_after_drain():
     assert stats["dropped_points"] == 1
     assert stats["points_sent"] == 0
     assert stats["retries"] >= 1
-    assert set(stats) == {"points_sent", "dropped_points", "retries"}
+    assert set(stats) == {"points_sent", "dropped_points",
+                          "spooled_points", "retries"}
 
 
 # --------------------------------------------------------------------------

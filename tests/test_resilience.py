@@ -1,0 +1,628 @@
+"""Resilient-execution tests (gossip_sim_tpu/resilience.py, ISSUE 7):
+journal atomicity + replay, kill-and-resume bit-exactness on every
+multi-unit run path, the device-dispatch watchdog (retry / CPU fallback /
+abort), and the resumable CLI exit code."""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from gossip_sim_tpu import resilience
+from gossip_sim_tpu.config import Config, StepSize, Testing
+from gossip_sim_tpu.obs import get_registry
+from gossip_sim_tpu.resilience import (RESUMABLE_EXIT_CODE, DispatchPolicy,
+                                       DeviceDispatchError,
+                                       DeviceTimeoutError, RunJournal,
+                                       journal_path, restore_stats,
+                                       snapshot_from_jsonable,
+                                       snapshot_to_jsonable,
+                                       stats_unit_payload, supervised_call)
+from gossip_sim_tpu.sinks import DatapointQueue
+from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience_state():
+    resilience.reset_shutdown()
+    resilience.set_fault_hook(None)
+    yield
+    resilience.reset_shutdown()
+    resilience.set_fault_hook(None)
+
+
+def _fresh(num_sims=1):
+    from gossip_sim_tpu.engine import clear_compile_cache, clear_lane_cache
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    reset_unique_pubkeys()
+    get_registry().reset()
+    resilience.reset_shutdown()
+    clear_compile_cache()
+    clear_lane_cache()
+    coll = GossipStatsCollection()
+    coll.set_number_of_simulations(num_sims)
+    return coll, DatapointQueue()
+
+
+def _sweep_cfg(**kw):
+    base = dict(num_synthetic_nodes=48, gossip_iterations=6,
+                warm_up_rounds=2, test_type=Testing.PACKET_LOSS,
+                num_simulations=4, step_size=StepSize.parse("0.1"),
+                packet_loss_rate=0.0, seed=13)
+    base.update(kw)
+    return Config(**base)
+
+
+def _snaps(coll):
+    return [s.parity_snapshot() for s in coll.collection]
+
+
+def _assert_parity(snaps_a, snaps_b, lines_a, lines_b):
+    assert len(snaps_a) == len(snaps_b)
+    for i, (a, b) in enumerate(zip(snaps_a, snaps_b)):
+        for k in a:
+            assert a[k] == b[k], f"sim{i}:{k}"
+    assert lines_a == lines_b
+
+
+# --------------------------------------------------------------------------
+# journal mechanics
+# --------------------------------------------------------------------------
+
+def test_journal_commit_load_roundtrip(tmp_path):
+    jp = str(tmp_path / "run.journal")
+    key = {"seed": 1, "kind": "serial-sweep"}
+    j = RunJournal(jp, key)
+    j.commit(0, {"x": 1})
+    j.commit(1, {"y": [1.5, 2.5]})
+    j.close()
+    j2 = RunJournal(jp, key, resume=True)
+    assert j2.committed_prefix() == 2
+    assert j2.records[0] == {"x": 1}
+    assert j2.records[1] == {"y": [1.5, 2.5]}
+
+
+def test_journal_tolerates_partial_trailing_line(tmp_path, caplog):
+    """A SIGKILL mid-append leaves a torn last line; the loader must drop
+    exactly that unit and keep every earlier one."""
+    import logging
+    jp = str(tmp_path / "run.journal")
+    key = {"seed": 1}
+    j = RunJournal(jp, key)
+    j.commit(0, {"ok": True})
+    j.commit(1, {"ok": True})
+    j.close()
+    with open(jp, "a") as f:
+        f.write('{"unit": 2, "payload": {"tor')   # torn mid-write
+    with caplog.at_level(logging.WARNING):
+        j2 = RunJournal(jp, key, resume=True)
+    assert j2.committed_prefix() == 2
+    assert any("partial" in r.message for r in caplog.records)
+    # committing after the torn line keeps the journal loadable
+    j2.commit(2, {"ok": True})
+    j2.close()
+    j3 = RunJournal(jp, key, resume=True)
+    assert j3.committed_prefix() == 3
+
+
+def test_journal_rejects_run_key_drift(tmp_path):
+    jp = str(tmp_path / "run.journal")
+    RunJournal(jp, {"seed": 1, "num_simulations": 4}).close()
+    with pytest.raises(SystemExit, match="seed"):
+        RunJournal(jp, {"seed": 2, "num_simulations": 4}, resume=True)
+
+
+def test_journal_overwrites_without_resume(tmp_path, caplog):
+    import logging
+    jp = str(tmp_path / "run.journal")
+    j = RunJournal(jp, {"seed": 1})
+    j.commit(0, {})
+    j.close()
+    with caplog.at_level(logging.WARNING):
+        j2 = RunJournal(jp, {"seed": 1})     # no resume flag: fresh run
+    assert j2.committed_prefix() == 0
+    assert any("overwriting" in r.message for r in caplog.records)
+
+
+# --------------------------------------------------------------------------
+# snapshot serialization + stats restoration
+# --------------------------------------------------------------------------
+
+def test_snapshot_json_roundtrip_and_restore():
+    """A finished sim's parity snapshot must survive
+    JSON-serialize -> JSON-parse -> restore_stats exactly — including
+    pubkey-keyed dicts, the failed set, and big lamport stakes."""
+    from gossip_sim_tpu.cli import run_simulation
+    coll, dpq = _fresh()
+    cfg = _sweep_cfg(num_simulations=1, packet_loss_rate=0.15)
+    run_simulation(cfg, "", coll, dpq, 0, "0", 0.0)
+    stats = coll.collection[0]
+    snap = stats.parity_snapshot()
+
+    payload = json.loads(json.dumps(stats_unit_payload(stats)))
+    assert snapshot_from_jsonable(payload["snapshot"]) == {
+        k: snap[k] for k in snap}
+
+    # rebuild the same cluster for stakes
+    from gossip_sim_tpu.cli import load_cluster_accounts
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    reset_unique_pubkeys()
+    accounts, _ = load_cluster_accounts(cfg, "")
+    restored = restore_stats(payload, cfg, dict(accounts))
+    rsnap = restored.parity_snapshot()
+    for k in snap:
+        assert rsnap[k] == snap[k], k
+    # and the re-finalized means match the live object's
+    from gossip_sim_tpu.cli import _build_final_stats
+    _build_final_stats(cfg, restored, dict(accounts))
+    assert restored.coverage_stats.mean == stats.coverage_stats.mean
+    assert restored.rmr_stats.mean == stats.rmr_stats.mean
+    ldh_a = stats.get_last_delivery_hop_stats()
+    ldh_b = restored.get_last_delivery_hop_stats()
+    assert ldh_a == ldh_b
+
+
+# --------------------------------------------------------------------------
+# kill-and-resume bit-exactness, per run path
+# --------------------------------------------------------------------------
+
+def _run_sweep(cfg, num_sims=4, kill_after=0):
+    from gossip_sim_tpu.cli import dispatch_sweeps
+    coll, dpq = _fresh(num_sims)
+    if kill_after:
+        # after _fresh: reset_shutdown() would wipe an earlier setting
+        resilience.set_kill_after_units(kill_after)
+    dispatch_sweeps(cfg, "", [1], coll, dpq, "0")
+    return coll, dpq.drain_deterministic_lines()
+
+
+def test_serial_sweep_kill_and_resume_bit_exact(tmp_path):
+    coll_a, lines_a = _run_sweep(_sweep_cfg())
+
+    ck = str(tmp_path / "sweep.npz")
+    with pytest.raises(resilience.ResumableInterrupt):
+        _run_sweep(_sweep_cfg(checkpoint_path=ck), kill_after=2)
+    assert os.path.exists(journal_path(ck))
+
+    coll_c, lines_c = _run_sweep(_sweep_cfg(checkpoint_path=ck,
+                                            resume_path=ck))
+    _assert_parity(_snaps(coll_a), _snaps(coll_c), lines_a, lines_c)
+    reg = get_registry()
+    assert reg.counter("resilience/resumed_units") == 2
+    assert reg.counter("resilience/committed_units") == 2  # sims 2, 3
+
+
+def test_lane_sweep_kill_and_resume_bit_exact(tmp_path):
+    cfg = _sweep_cfg(num_simulations=5, sweep_lanes=2)
+    coll_a, lines_a = _run_sweep(cfg, 5)
+
+    ck = str(tmp_path / "lane.npz")
+    with pytest.raises(resilience.ResumableInterrupt):
+        _run_sweep(_sweep_cfg(num_simulations=5, sweep_lanes=2,
+                              checkpoint_path=ck), 5,
+                   kill_after=1)             # after lane batch 0 of 3
+
+    coll_c, lines_c = _run_sweep(
+        _sweep_cfg(num_simulations=5, sweep_lanes=2, checkpoint_path=ck,
+                   resume_path=ck), 5)
+    _assert_parity(_snaps(coll_a), _snaps(coll_c), lines_a, lines_c)
+    # the resumed process recomputed batches 1-2 with ONE compile and
+    # replayed batch 0 without touching the engine
+    assert get_registry().counter("engine/compiles") == 1
+
+
+def test_all_origins_kill_and_resume_bit_exact(tmp_path):
+    from gossip_sim_tpu.cli import run_all_origins
+
+    def cfg(**kw):
+        return Config(num_synthetic_nodes=40, gossip_iterations=5,
+                      warm_up_rounds=2, all_origins=True, origin_batch=16,
+                      seed=9, **kw)
+
+    _fresh()
+    dq = DatapointQueue()
+    s_a = run_all_origins(cfg(), "", dq, "0")
+    lines_a = dq.drain_deterministic_lines()
+
+    ck = str(tmp_path / "ao.npz")
+    _fresh()
+    resilience.set_kill_after_units(1)       # after origin batch 0 of 3
+    with pytest.raises(resilience.ResumableInterrupt):
+        run_all_origins(cfg(checkpoint_path=ck), "", DatapointQueue(), "0")
+    assert os.path.exists(journal_path(ck))
+    assert os.path.exists(str(tmp_path / "ao.aggstate.npz"))
+
+    _fresh()
+    dq2 = DatapointQueue()
+    s_c = run_all_origins(cfg(checkpoint_path=ck, resume_path=ck), "",
+                          dq2, "0")
+    lines_c = dq2.drain_deterministic_lines()
+    for k in s_a:
+        if k in ("elapsed_s", "origin_iters_per_sec", "stats"):
+            continue
+        assert s_a[k] == s_c[k], k
+    assert lines_a == lines_c
+
+
+def test_all_origins_sidecar_ahead_of_journal_reconciles(tmp_path):
+    """A kill between the sidecar save and the journal commit leaves the
+    aggregate one batch ahead; resume must commit the missing record
+    instead of re-folding the batch (which would double-count its
+    origins)."""
+    from gossip_sim_tpu.cli import run_all_origins
+
+    def cfg(**kw):
+        return Config(num_synthetic_nodes=40, gossip_iterations=5,
+                      warm_up_rounds=2, all_origins=True, origin_batch=16,
+                      seed=9, **kw)
+
+    _fresh()
+    s_a = run_all_origins(cfg(), "", None, "0")
+
+    ck = str(tmp_path / "ao.npz")
+    _fresh()
+    resilience.set_kill_after_units(2)
+    with pytest.raises(resilience.ResumableInterrupt):
+        run_all_origins(cfg(checkpoint_path=ck), "", None, "0")
+    # simulate the crash window: drop the journal's last record while the
+    # sidecar keeps both batches folded
+    jp = journal_path(ck)
+    lines = open(jp).read().splitlines()
+    open(jp, "w").write("\n".join(lines[:-1]) + "\n")
+
+    _fresh()
+    s_c = run_all_origins(cfg(checkpoint_path=ck, resume_path=ck), "",
+                          None, "0")
+    for k in s_a:
+        if k in ("elapsed_s", "origin_iters_per_sec", "stats"):
+            continue
+        assert s_a[k] == s_c[k], k
+
+
+def test_origin_rank_sweep_kill_and_resume_bit_exact(tmp_path, monkeypatch):
+    import gossip_sim_tpu.cli as cli
+
+    monkeypatch.setattr(cli, "HARVEST_BLOCK", 2)   # several units per run
+
+    def cfg(**kw):
+        return Config(num_synthetic_nodes=40, gossip_iterations=8,
+                      warm_up_rounds=2, test_type=Testing.ORIGIN_RANK,
+                      num_simulations=3, step_size=StepSize.parse("1"),
+                      seed=9, **kw)
+
+    ranks = [1, 3, 5]
+
+    def run(c, kill_after=0):
+        coll, dpq = _fresh(3)
+        if kill_after:
+            resilience.set_kill_after_units(kill_after)
+        cli.run_origin_rank_sweep(c, "", ranks, coll, dpq, "0")
+        return coll, dpq.drain_deterministic_lines()
+
+    coll_a, lines_a = run(cfg())
+    ck = str(tmp_path / "orank.npz")
+    with pytest.raises(resilience.ResumableInterrupt):
+        run(cfg(checkpoint_path=ck), kill_after=2)  # after block 1 of 3
+    # the v5 state npz carries the journal cross-reference
+    from gossip_sim_tpu.checkpoint import load_state
+    _, _, meta = load_state(ck)
+    assert meta["resilience"]["committed_units"] == 2
+    assert meta["resilience"]["journal"] == "orank.journal"
+
+    coll_c, lines_c = run(cfg(checkpoint_path=ck, resume_path=ck))
+    _assert_parity(_snaps(coll_a), _snaps(coll_c), lines_a, lines_c)
+
+
+def test_sweep_without_journal_still_stops_on_shutdown():
+    """SIGTERM without --checkpoint-path: the run still stops promptly —
+    the in-flight sim aborts at its next harvest-block boundary (nothing
+    to resume from, but it must not run on for hours)."""
+    resilience.set_kill_after_units(0)
+    coll, dpq = _fresh(4)
+    from gossip_sim_tpu.cli import dispatch_sweeps
+    resilience.request_shutdown()
+    with pytest.raises(resilience.ResumableInterrupt):
+        dispatch_sweeps(_sweep_cfg(), "", [1], coll, dpq, "0")
+    # the aborted sim never finalized: nothing partial leaks out
+    assert len(coll.collection) == 0
+
+
+# --------------------------------------------------------------------------
+# device-dispatch supervisor
+# --------------------------------------------------------------------------
+
+def test_supervised_call_retries_transient_errors():
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("transient XLA flake")
+        return "ok"
+
+    reg = get_registry()
+    reg.reset()
+    pol = DispatchPolicy(retries=2, backoff_s=0.001)
+    assert supervised_call("t", attempt, pol) == "ok"
+    assert len(calls) == 3
+    assert reg.counter("resilience/device_failures") == 2
+
+
+def test_supervised_call_does_not_retry_programming_errors():
+    def attempt():
+        raise ValueError("shape mismatch is a bug, not a flake")
+    with pytest.raises(ValueError):
+        supervised_call("t", attempt, DispatchPolicy(retries=3,
+                                                     backoff_s=0.001))
+
+
+def test_supervised_call_timeout_then_recovery():
+    calls = []
+
+    def attempt():
+        calls.append(1)
+        if len(calls) == 1:
+            time.sleep(0.5)              # hung first dispatch
+        return "ok"
+
+    pol = DispatchPolicy(timeout_s=0.05, retries=1, backoff_s=0.001)
+    assert supervised_call("t", attempt, pol) == "ok"
+    assert len(calls) == 2
+
+
+def test_supervised_call_cpu_fallback_and_abort():
+    def attempt():
+        raise RuntimeError("persistently broken device")
+
+    reg = get_registry()
+    reg.reset()
+    pol = DispatchPolicy(retries=1, backoff_s=0.001,
+                         on_failure="cpu-fallback")
+    out = supervised_call("t", attempt, pol, cpu_fallback=lambda: "cpu")
+    assert out == "cpu"
+    assert reg.counter("resilience/fallback_units") == 1
+
+    with pytest.raises(DeviceDispatchError, match="--resume"):
+        supervised_call("t", attempt,
+                        DispatchPolicy(retries=0, backoff_s=0.001,
+                                       on_failure="abort"),
+                        cpu_fallback=lambda: "cpu")
+
+
+def test_timeout_error_is_transient():
+    assert resilience._is_transient(DeviceTimeoutError("x"))
+    assert resilience._is_transient(RuntimeError("x"))
+    assert not resilience._is_transient(TypeError("x"))
+    # RuntimeError subclasses that are programming errors, not flakes
+    assert not resilience._is_transient(NotImplementedError("x"))
+    assert not resilience._is_transient(RecursionError("x"))
+
+
+def test_journal_mode_rejects_split_checkpoint_resume_paths(tmp_path):
+    with pytest.raises(SystemExit, match="SAME path"):
+        _run_sweep(_sweep_cfg(checkpoint_path=str(tmp_path / "a.npz"),
+                              resume_path=str(tmp_path / "b.npz")))
+
+
+def test_injected_device_failure_retries_to_correct_stats():
+    """Acceptance: an injected dispatch failure is retried with backoff
+    and the run's stats are bit-identical to an undisturbed run."""
+    from gossip_sim_tpu.cli import run_simulation
+
+    def run(c):
+        coll, _ = _fresh()
+        run_simulation(c, "", coll, None, 0, "0", 0.0)
+        return coll.collection[0].parity_snapshot()
+
+    ref = run(_sweep_cfg(num_simulations=1))
+
+    def hook(label, attempt):
+        if label.startswith("measured-block") and attempt < 2:
+            raise RuntimeError(f"injected failure at {label}")
+
+    resilience.set_fault_hook(hook)
+    try:
+        c = _sweep_cfg(num_simulations=1, device_retries=2)
+        c.device_backoff_s = 0.001
+        snap = run(c)
+    finally:
+        resilience.set_fault_hook(None)
+    for k in ref:
+        assert ref[k] == snap[k], k
+    assert get_registry().counter("resilience/device_failures") >= 2
+
+
+def test_injected_failure_cpu_fallback_flags_report():
+    """Acceptance: --on-device-failure cpu-fallback completes the unit
+    with correct stats and the run report flags it."""
+    from gossip_sim_tpu.cli import run_simulation
+    from gossip_sim_tpu.obs.report import build_run_report
+
+    def run(c):
+        coll, _ = _fresh()
+        run_simulation(c, "", coll, None, 0, "0", 0.0)
+        return coll.collection[0].parity_snapshot()
+
+    ref = run(_sweep_cfg(num_simulations=1))
+
+    def hook(label, attempt):
+        if label.startswith("measured-block"):
+            raise RuntimeError("dead device")
+
+    resilience.set_fault_hook(hook)
+    try:
+        c = _sweep_cfg(num_simulations=1, device_retries=1,
+                       on_device_failure="cpu-fallback")
+        c.device_backoff_s = 0.001
+        snap = run(c)
+    finally:
+        resilience.set_fault_hook(None)
+    for k in ref:
+        assert ref[k] == snap[k], k
+    report = build_run_report(_sweep_cfg(), get_registry())
+    assert report["resilience"]["fallback_units"] >= 1
+    assert report["resilience"]["device_failures"] >= 2
+
+
+def test_abort_exits_with_resumable_code_and_committed_journal(tmp_path):
+    """Acceptance: --on-device-failure abort -> RESUMABLE_EXIT_CODE from
+    the CLI, with every earlier unit committed in the journal."""
+    from gossip_sim_tpu.cli import main
+
+    ck = str(tmp_path / "abort.npz")
+    fails = []
+
+    def hook(label, attempt):
+        # fail the second sweep sim's engine calls forever
+        if label.startswith("warmup") and fails.count("armed") >= 1:
+            raise RuntimeError("dead device")
+        if label.startswith("warmup"):
+            fails.append("armed")
+
+    _fresh()
+    resilience.set_fault_hook(hook)
+    try:
+        rc = main(["--num-synthetic-nodes", "48", "--iterations", "6",
+                   "--warm-up-rounds", "2", "--test-type", "packet-loss",
+                   "--num-simulations", "3", "--step-size", "0.1",
+                   "--seed", "13", "--checkpoint-path", ck,
+                   "--device-retries", "0", "--on-device-failure", "abort"])
+    finally:
+        resilience.set_fault_hook(None)
+    assert rc == RESUMABLE_EXIT_CODE
+    # sim 0 committed before sim 1's dispatch died
+    with open(journal_path(ck)) as f:
+        recs = [json.loads(ln) for ln in f.read().splitlines()]
+    assert [r["unit"] for r in recs[1:]] == [0]
+
+
+def test_cli_sigterm_returns_resumable_exit_code(tmp_path, monkeypatch):
+    """kill-after-units (via the env hook — main() resets programmatic
+    shutdown state on entry) sends a real SIGTERM through signal_guard;
+    main() must finish the in-flight unit, commit, and return 75."""
+    from gossip_sim_tpu.cli import main
+
+    ck = str(tmp_path / "sig.npz")
+    _fresh()
+    monkeypatch.setenv(resilience.KILL_AFTER_ENV, "1")
+    rc = main(["--num-synthetic-nodes", "48", "--iterations", "6",
+               "--warm-up-rounds", "2", "--test-type", "packet-loss",
+               "--num-simulations", "3", "--step-size", "0.1",
+               "--seed", "13", "--checkpoint-path", ck])
+    assert rc == RESUMABLE_EXIT_CODE
+    with open(journal_path(ck)) as f:
+        recs = [json.loads(ln) for ln in f.read().splitlines()]
+    assert [r["unit"] for r in recs[1:]] == [0]
+    # and the resumed CLI run completes cleanly
+    monkeypatch.delenv(resilience.KILL_AFTER_ENV)
+    _fresh()
+    rc2 = main(["--num-synthetic-nodes", "48", "--iterations", "6",
+                "--warm-up-rounds", "2", "--test-type", "packet-loss",
+                "--num-simulations", "3", "--step-size", "0.1",
+                "--seed", "13", "--checkpoint-path", ck, "--resume", ck])
+    assert rc2 == 0
+
+
+# --------------------------------------------------------------------------
+# single-run autosave + satellites
+# --------------------------------------------------------------------------
+
+def test_checkpoint_every_s_throttles_block_saves(tmp_path, monkeypatch):
+    import gossip_sim_tpu.cli as cli
+    from gossip_sim_tpu.checkpoint import load_state
+    from gossip_sim_tpu.cli import run_simulation
+
+    monkeypatch.setattr(cli, "HARVEST_BLOCK", 2)
+    saves = []
+    import gossip_sim_tpu.checkpoint as cp
+    real = cp.save_state
+
+    def counting_save(*a, **kw):
+        saves.append(kw.get("iteration", a[4] if len(a) > 4 else None))
+        return real(*a, **kw)
+
+    monkeypatch.setattr(cp, "save_state", counting_save)
+    ck = str(tmp_path / "single.npz")
+    coll, _ = _fresh()
+    # a huge interval: only the forced saves (post-warm-up + end) write
+    run_simulation(_sweep_cfg(num_simulations=1, checkpoint_path=ck,
+                              checkpoint_every_s=3600.0),
+                   "", coll, None, 0, "0", 0.0)
+    assert len(saves) == 2
+    _, _, meta = load_state(ck)
+    assert meta["iteration"] == 6
+
+    saves.clear()
+    coll, _ = _fresh()
+    # interval 0 = the pre-resilience cadence: every measured block
+    run_simulation(_sweep_cfg(num_simulations=1, checkpoint_path=ck),
+                   "", coll, None, 0, "0", 0.0)
+    # post-warm-up + two 2-round blocks + the forced end-of-run save
+    assert len(saves) == 4
+
+
+def test_heartbeat_carries_resumability_marker():
+    from gossip_sim_tpu.obs import Heartbeat
+    hb = Heartbeat(10, label="sweep", unit="sim")
+    msg = hb.beat(3, force=True)
+    assert "committed" not in msg
+    hb.note_committed(3)
+    msg = hb.beat(4, force=True)
+    assert "committed 3/10, resumable" in msg
+
+
+def test_run_report_resilience_keys_default_zero():
+    from gossip_sim_tpu.obs import build_run_report, validate_run_report
+    from gossip_sim_tpu.obs.spans import SpanRegistry
+    report = build_run_report(Config(), SpanRegistry())
+    assert validate_run_report(report) == []
+    assert report["resilience"] == {
+        "committed_units": 0, "resumed_units": 0,
+        "device_failures": 0, "fallback_units": 0}
+
+
+def test_run_report_write_is_atomic(tmp_path, monkeypatch):
+    from gossip_sim_tpu.obs.report import write_run_report
+    path = str(tmp_path / "report.json")
+    write_run_report(path, {"ok": 1})
+    good = open(path).read()
+
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError, match="disk full"):
+        write_run_report(path, {"ok": 2})
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert open(path).read() == good
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["report.json"]
+
+
+def test_aggregate_state_dict_roundtrip():
+    """AllOriginsStats sidecar snapshot: save after batch 1, load into a
+    fresh instance, fold batch 2 — finalize must equal the straight-
+    through accumulation."""
+    from gossip_sim_tpu.cli import run_all_origins
+
+    def cfg(**kw):
+        return Config(num_synthetic_nodes=40, gossip_iterations=5,
+                      warm_up_rounds=2, all_origins=True, origin_batch=20,
+                      seed=9, **kw)
+
+    _fresh()
+    s = run_all_origins(cfg(), "", None, "0")
+    agg = s["stats"]
+    sd = agg.state_dict()
+    from gossip_sim_tpu.identity import NodeIndex
+    fresh_agg = type(agg)(agg.index, agg.hist_bins)
+    fresh_agg.load_state_dict({k: np.asarray(v) for k, v in sd.items()})
+    fresh_agg.finalize(cfg())
+    assert fresh_agg.coverage_stats.mean == agg.coverage_stats.mean
+    assert fresh_agg.rmr_stats.mean == agg.rmr_stats.mean
+    assert (fresh_agg.hops_hist == agg.hops_hist).all()
+    assert fresh_agg.measured_points == agg.measured_points

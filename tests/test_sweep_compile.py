@@ -573,13 +573,23 @@ def test_lane_sweep_influx_and_stats_parity_churn_and_pull():
         assert get_registry().counter("engine/compiles") == 1
 
 
-def test_lane_sweep_rejects_trace_and_checkpoint():
+def test_lane_sweep_rejects_trace_but_journals_checkpoints(tmp_path):
+    """--trace-dir stays rejected in lane mode; --checkpoint-path is now
+    REAL support (ISSUE 7 lifted guard_lane_checkpoint): a lane sweep
+    writes a per-batch run journal instead of erroring out."""
     with pytest.raises(SystemExit, match="trace-dir"):
         _run_lane_dispatch(_lane_cli_config(sweep_lanes=2,
                                             trace_dir="/tmp/nope"))
-    with pytest.raises(SystemExit, match="checkpoint"):
-        _run_lane_dispatch(_lane_cli_config(sweep_lanes=2,
-                                            checkpoint_path="/tmp/nope.npz"))
+    ck = str(tmp_path / "lane.npz")
+    coll, _ = _run_lane_dispatch(_lane_cli_config(sweep_lanes=2,
+                                                  checkpoint_path=ck))
+    assert len(coll.collection) == 5
+    import json
+    from gossip_sim_tpu.resilience import journal_path
+    lines = open(journal_path(ck)).read().splitlines()
+    # header + one committed unit per lane batch (5 sims at 2 lanes = 3)
+    assert len(lines) == 1 + 3
+    assert [json.loads(ln)["unit"] for ln in lines[1:]] == [0, 1, 2]
 
 
 def test_lane_sweep_falls_back_serially_for_shape_sweeps(caplog):

@@ -9,6 +9,9 @@ The gates, in dependency-light-first order:
   pull_smoke    pull-gossip subsystem (healing, zero bit-impact, parity)
   lane_smoke    device-resident sweep lanes (bit-exact vs serial, 1
                 compile, wall-clock < serial)
+  resume_smoke  resilient execution (ISSUE 7): SIGTERM mid lane sweep ->
+                resumable exit code, bit-exact --resume with zero
+                persistent-cache misses, journal+watchdog overhead < 2%
 
 Usage: python tools/ci_gates.py [--only NAME[,NAME...]]
 
@@ -23,7 +26,7 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
-         "pull_smoke", "lane_smoke"]
+         "pull_smoke", "lane_smoke", "resume_smoke"]
 
 
 def main() -> int:

@@ -1,0 +1,96 @@
+"""Re-send a durable Influx spool (``--influx-spool``, sinks/influx.py).
+
+A run whose InfluxDB endpoint was down past the sender's retry budget
+appends the affected points — original per-point timestamps included — to
+an on-disk line-protocol spool instead of discarding them.  This tool
+replays that spool against the endpoint once it is healthy:
+
+  python tools/influx_replay.py SPOOL [--influx l|i] [--batch 200]
+                                [--dry-run] [--keep]
+
+Credentials come from the same env/.env variables the simulator uses
+(GOSSIP_SIM_INFLUX_USERNAME / _PASSWORD / _DATABASE).  Each batch goes
+through the simulator's own sender (retry + backoff, sinks/influx.py), so
+transient hiccups during replay are absorbed the same way.  On full
+success the spool is renamed to ``<spool>.sent`` (``--keep`` leaves it);
+on partial failure the spool is left untouched so the replay can be
+re-run — InfluxDB deduplicates points on identical series + timestamp, so
+re-sending an already-delivered line is harmless.
+
+Exit code 0 = every point acknowledged (or --dry-run), 1 = sends failed,
+2 = usage/credential errors.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="re-send a durable Influx line-protocol spool")
+    ap.add_argument("spool", help="spool file written via --influx-spool")
+    ap.add_argument("--influx", default="l", choices=["l", "i"],
+                    help="endpoint selector, as the simulator's --influx "
+                         "(l = localhost, i = internal-metrics)")
+    ap.add_argument("--batch", type=int, default=200,
+                    help="lines per POST body")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="parse + count the spool, send nothing")
+    ap.add_argument("--keep", action="store_true",
+                    help="do not rename the spool after a full replay")
+    args = ap.parse_args()
+
+    from gossip_sim_tpu.constants import get_influx_url
+    from gossip_sim_tpu.sinks import InfluxDB, load_dotenv
+
+    if not os.path.exists(args.spool):
+        print(f"spool not found: {args.spool}")
+        return 2
+    with open(args.spool) as f:
+        raw = f.read().splitlines()
+    # a torn final line (killed mid-append) is unparseable line protocol:
+    # a valid point line ends in a nanosecond timestamp token
+    lines = []
+    for ln in raw:
+        ln = ln.strip()
+        if not ln:
+            continue
+        tail = ln.rsplit(" ", 1)[-1]
+        if not tail.isdigit():
+            print(f"skipping torn/invalid spool line: {ln[:60]!r}...")
+            continue
+        lines.append(ln)
+    print(f"{args.spool}: {len(lines)} point line(s)")
+    if args.dry_run or not lines:
+        return 0
+
+    load_dotenv()
+    try:
+        username = os.environ["GOSSIP_SIM_INFLUX_USERNAME"]
+        password = os.environ["GOSSIP_SIM_INFLUX_PASSWORD"]
+        database = os.environ["GOSSIP_SIM_INFLUX_DATABASE"]
+    except KeyError as e:
+        print(f"{e.args[0]} is not set")
+        return 2
+
+    db = InfluxDB(get_influx_url(args.influx), username, password, database)
+    sent_before = 0
+    for lo in range(0, len(lines), args.batch):
+        body = "\n".join(lines[lo:lo + args.batch]) + "\n"
+        db._post(body)
+    stats = db.sender_stats()
+    ok = stats["dropped_points"] == 0 and stats["points_sent"] > sent_before
+    print(f"replay: {stats['points_sent']} batch(es) acknowledged, "
+          f"{stats['dropped_points']} failed, {stats['retries']} retries")
+    if ok and not args.keep:
+        os.replace(args.spool, args.spool + ".sent")
+        print(f"spool renamed to {args.spool}.sent")
+    elif not ok:
+        print("spool left in place; re-run once the endpoint is healthy")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
