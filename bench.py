@@ -192,6 +192,44 @@ def worker(args) -> int:
     lane_dt = time.perf_counter() - t_lane
     lane_compiles = (lane_cache_size() - c_warm if c_warm >= 0 else -1)
 
+    # ---- traffic rung: M concurrent values on one shared network -------
+    # (traffic.py / engine/traffic.py, ISSUE 10).  M=64 in-flight values
+    # at n<=1000 under both queue caps — the heavy-traffic workload the
+    # ROADMAP's "millions of users" north star asks about.  Records round
+    # throughput AND values-converged/s (the number that actually matters
+    # for a traffic workload: how fast the network finishes values).
+    from gossip_sim_tpu.engine.traffic import (device_traffic_tables,
+                                               init_traffic_state,
+                                               run_traffic_rounds)
+    tn = min(n, 1_000)
+    tstakes = synthetic_stakes(tn)
+    ttables_c = make_cluster_tables(tstakes) if tn != n else tables
+    # caps sized for *measurable* contention: tight enough that queue
+    # deferrals/drops are nonzero, loose enough that values still finish
+    # inside the timed window (values-converged/s must not read 0 on a
+    # healthy engine)
+    tparams = EngineParams(
+        num_nodes=tn, warm_up_rounds=0, traffic_values=64, traffic_rate=4,
+        node_ingress_cap=256, node_egress_cap=384, traffic_stall_rounds=4)
+    tt = device_traffic_tables(tstakes)
+    titers = max(5, min(20, args.iterations))
+    tstate = init_traffic_state(tstakes, tparams, seed=0)
+    t_tc = time.perf_counter()
+    tstate, trows = run_traffic_rounds(tparams, ttables_c, tt, tstate, 3)
+    jax.block_until_ready(trows["converged"])
+    traffic_compile_dt = time.perf_counter() - t_tc
+    t_tr = time.perf_counter()
+    tstate, trows = run_traffic_rounds(tparams, ttables_c, tt, tstate,
+                                       titers, start_it=3)
+    jax.block_until_ready(trows["converged"])
+    traffic_dt = time.perf_counter() - t_tr
+    traffic_converged = int(np.asarray(trows["converged"]).sum())
+    traffic_retired = int(np.asarray(trows["retired"]).sum())
+    _rm = np.asarray(trows["ret_mask"])
+    traffic_ret_cov = (float(np.asarray(trows["ret_holders"])[_rm].sum()
+                             / (tn * max(traffic_retired, 1)))
+                       if traffic_retired else 0.0)
+
     result = bench_summary(
         reg, platform=platform, num_nodes=n, origin_batch=o,
         iterations=args.iterations,
@@ -216,6 +254,28 @@ def worker(args) -> int:
                                   (sweep_steps / sweep_dt), 3)
                             if lane_dt > 0 and sweep_dt > 0
                             and sweep_steps else 0.0),
+    }
+    result["traffic_steps_per_sec"] = round(
+        titers / traffic_dt, 2) if traffic_dt > 0 else 0.0
+    result["traffic"] = {
+        "num_nodes": tn,
+        "traffic_values": tparams.traffic_values,
+        "traffic_rate": tparams.traffic_rate,
+        "node_ingress_cap": tparams.node_ingress_cap,
+        "node_egress_cap": tparams.node_egress_cap,
+        "timed_rounds": titers,
+        "warm_elapsed_s": round(traffic_dt, 3),
+        "first_call_elapsed_s": round(traffic_compile_dt, 3),
+        "values_converged": traffic_converged,
+        "values_retired": traffic_retired,
+        "values_converged_per_sec": (round(traffic_converged / traffic_dt, 2)
+                                     if traffic_dt > 0 else 0.0),
+        "values_retired_per_sec": (round(traffic_retired / traffic_dt, 2)
+                                   if traffic_dt > 0 else 0.0),
+        "retired_coverage_mean": round(traffic_ret_cov, 4),
+        "injected": int(np.asarray(trows["injected"]).sum()),
+        "queue_dropped": int(np.asarray(trows["queue_dropped"]).sum()),
+        "deferred": int(np.asarray(trows["deferred"]).sum()),
     }
     pc = persistent_cache_counters()
     result["compilation_cache"] = {

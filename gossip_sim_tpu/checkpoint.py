@@ -22,7 +22,7 @@ from .engine.params import EngineParams
 
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 5
+_FORMAT_VERSION = 6
 # v1 checkpoints predate the tfail/rc_shi/rc_slo SimState fields; all three
 # are derivable from active/failed/rc_src plus the cluster stake table, so
 # v1 files remain loadable when ``tables`` is passed to restore_sim_state.
@@ -40,10 +40,17 @@ _FORMAT_VERSION = 5
 # run-journal layer (resilience.py): a ``resilience`` meta block naming
 # the sibling journal file and the committed-unit count at save time, so
 # a resumed run can cross-check the state npz against the journal.  No
-# new arrays — pre-v5 files backfill an empty block and stay loadable;
-# the committed v1-v4 fixtures in tests/fixtures/checkpoints pin that
-# forward-compat contract forever (tests/test_checkpoint.py).
-_READABLE_VERSIONS = (1, 2, 3, 4, 5)
+# new arrays — pre-v5 files backfill an empty block and stay loadable.
+# v6 adds the concurrent-traffic subsystem (traffic.py): a ``traffic``
+# meta block (knob schedule) on every checkpoint plus a second checkpoint
+# *kind* — ``kind="traffic"`` files carry a ``TrafficState`` pytree
+# (shared active set, M value slots, queue accumulators) and the
+# serialized TrafficStats, written/read by save_traffic_state /
+# restore_traffic_state.  Pre-v6 files backfill an all-off traffic block
+# and kind "sim"; the committed v1-v5 fixtures in
+# tests/fixtures/checkpoints pin that forward-compat contract forever
+# (tests/test_checkpoint.py).
+_READABLE_VERSIONS = (1, 2, 3, 4, 5, 6)
 
 # EngineParams fields that define array shapes; a mismatch makes the stored
 # state unusable under the new compile geometry.
@@ -65,28 +72,52 @@ _PULL_FIELDS = ("gossip_mode", "pull_fanout", "pull_interval",
                 "pull_bloom_fp_rate", "pull_request_cap")
 _PULL_DEFAULTS = {f: EngineParams._field_defaults[f] for f in _PULL_FIELDS}
 
+# EngineParams fields describing the concurrent-traffic schedule (v6 meta
+# block); same contract as impair/pull — knobs + state fully determine a
+# bit-exact continuation (every traffic decision is a stateless counter
+# hash of (impair_seed, iteration, ids), traffic.py).
+_TRAFFIC_FIELDS = ("traffic_values", "traffic_rate", "node_ingress_cap",
+                   "node_egress_cap", "traffic_stall_rounds")
+_TRAFFIC_DEFAULTS = {f: EngineParams._field_defaults[f]
+                     for f in _TRAFFIC_FIELDS}
+
+# shape-defining fields for kind="traffic" checkpoints (TrafficState
+# arrays are [V, N, ...]-shaped; hist_bins never shapes traffic state)
+_TRAFFIC_SHAPE_FIELDS = ("num_nodes", "active_set_size", "rc_slots",
+                         "traffic_values")
+
 
 def save_state(path: str, state, params, config=None,
-               iteration: int = 0, resilience: dict | None = None) -> None:
+               iteration: int = 0, resilience: dict | None = None,
+               kind: str = "sim", extra_meta: dict | None = None) -> None:
     """Write SimState + EngineParams (+ optional Config) to one .npz.
 
     ``iteration`` records how many gossip rounds produced this state; a
     resumed run continues from there (the engine's per-round RNG keys fold
-    in the absolute iteration number, so resumption is bit-exact)."""
+    in the absolute iteration number, so resumption is bit-exact).
+    ``kind`` distinguishes the state pytree stored: "sim" (SimState) or
+    "traffic" (TrafficState, v6); ``extra_meta`` merges extra JSON-able
+    blocks into the meta (e.g. the serialized TrafficStats)."""
     arrays = {f"state.{name}": np.asarray(getattr(state, name))
               for name in state._fields}
     pdict = dict(params._asdict())
     meta = {
         "format_version": _FORMAT_VERSION,
+        "kind": str(kind),
         "params": pdict,
         "impair": {f: pdict.get(f, _IMPAIR_DEFAULTS[f])
                    for f in _IMPAIR_FIELDS},
         "pull": {f: pdict.get(f, _PULL_DEFAULTS[f]) for f in _PULL_FIELDS},
+        # v6: the concurrent-traffic schedule (all-off on plain sims)
+        "traffic": {f: pdict.get(f, _TRAFFIC_DEFAULTS[f])
+                    for f in _TRAFFIC_FIELDS},
         "iteration": int(iteration),
         # v5: journal cross-reference (resilience.py) — {} for plain
         # single-run checkpoints with no journal alongside
         "resilience": dict(resilience or {}),
     }
+    if extra_meta:
+        meta.update(extra_meta)
     if config is not None:
         cfg = dict(vars(config))
         cfg["test_type"] = str(cfg["test_type"])
@@ -112,11 +143,15 @@ def save_state(path: str, state, params, config=None,
     log.info("checkpoint saved: %s (%s arrays)", path, len(arrays))
 
 
-def load_state(path: str, params=None):
+def load_state(path: str, params=None, expect_kind=None):
     """Read a checkpoint -> (SimState-field dict, stored-params dict, meta).
 
     If ``params`` is given, shape-defining fields are validated against the
-    stored ones and a mismatch raises ``ValueError``.
+    stored ones and a mismatch raises ``ValueError``.  ``expect_kind``
+    ("sim"/"traffic") rejects a wrong-kind file BEFORE the shape check, so
+    the caller's guidance message wins over a confusing shape mismatch
+    (e.g. ``traffic_values=64 != current 1`` on a plain-run --resume of a
+    traffic checkpoint).
     """
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
@@ -127,12 +162,24 @@ def load_state(path: str, params=None):
                   if k.startswith("state.")}
     stored = meta["params"]
     # pre-v3 backfill: impairment knobs default to all-off; pre-v4: the
-    # push-only mode; pre-v5: no journal alongside
+    # push-only mode; pre-v5: no journal alongside; pre-v6: traffic off,
+    # kind "sim"
     meta.setdefault("impair", dict(_IMPAIR_DEFAULTS))
     meta.setdefault("pull", dict(_PULL_DEFAULTS))
     meta.setdefault("resilience", {})
+    meta.setdefault("traffic", dict(_TRAFFIC_DEFAULTS))
+    meta.setdefault("kind", "sim")
+    if expect_kind is not None and meta["kind"] != expect_kind:
+        hint = ("restore_traffic_state / the --traffic-values run path"
+                if meta["kind"] == "traffic" else "restore_sim_state")
+        raise ValueError(
+            f"checkpoint {path} holds a {meta['kind']!r}-kind state, not "
+            f"{expect_kind!r}; resume it with the matching run mode "
+            f"({hint})")
     if params is not None:
-        for f in _SHAPE_FIELDS:
+        shape_fields = (_TRAFFIC_SHAPE_FIELDS if meta["kind"] == "traffic"
+                        else _SHAPE_FIELDS)
+        for f in shape_fields:
             if getattr(params, f) != stored[f]:
                 raise ValueError(
                     f"checkpoint {f}={stored[f]} != current {getattr(params, f)}")
@@ -152,6 +199,14 @@ def load_state(path: str, params=None):
                     "from the original run",
                     f, getattr(params, f, _PULL_DEFAULTS[f]),
                     meta["pull"][f])
+        for f in _TRAFFIC_FIELDS:
+            if getattr(params, f, _TRAFFIC_DEFAULTS[f]) != meta["traffic"][f]:
+                log.warning(
+                    "WARNING: resuming with %s=%s but checkpoint was written "
+                    "with %s — the continuation's traffic schedule diverges "
+                    "from the original run",
+                    f, getattr(params, f, _TRAFFIC_DEFAULTS[f]),
+                    meta["traffic"][f])
     return arrays, stored, meta
 
 
@@ -165,7 +220,7 @@ def restore_sim_state(path: str, params=None, tables=None):
 
     from .engine import SimState
 
-    arrays, stored, meta = load_state(path, params)
+    arrays, stored, meta = load_state(path, params, expect_kind="sim")
     missing = set(SimState._fields) - set(arrays)
     # pre-v4 files were written by the push-only engine: the pull
     # accumulators are exactly zero (no pull round ever ran)
@@ -199,3 +254,35 @@ def restore_sim_state(path: str, params=None, tables=None):
         raise ValueError(f"checkpoint missing fields: {sorted(missing)}")
     return SimState(**{f: jnp.asarray(arrays[f]) for f in SimState._fields}), \
         stored, meta
+
+
+def save_traffic_state(path: str, state, params, config=None,
+                       iteration: int = 0,
+                       traffic_stats: dict | None = None) -> None:
+    """Write a kind="traffic" v6 checkpoint: the TrafficState pytree
+    (shared active set, M value slots, queue accumulators) plus the
+    serialized TrafficStats (stats/traffic.py state_dict) so a resumed
+    run re-reports the pre-interrupt rounds and retirement records
+    exactly."""
+    save_state(path, state, params, config=config, iteration=iteration,
+               kind="traffic",
+               extra_meta={"traffic_stats": traffic_stats or {}})
+
+
+def restore_traffic_state(path: str, params=None):
+    """Read a kind="traffic" checkpoint -> (TrafficState, stored-params,
+    meta).  ``meta["traffic_stats"]`` carries the TrafficStats snapshot
+    for stats-exact resume."""
+    import jax.numpy as jnp
+
+    from .engine.traffic import TrafficState
+
+    arrays, stored, meta = load_state(path, params, expect_kind="traffic")
+    missing = set(TrafficState._fields) - set(arrays)
+    if missing:
+        raise ValueError(f"traffic checkpoint missing fields: "
+                         f"{sorted(missing)}")
+    state = TrafficState(**{f: jnp.asarray(arrays[f])
+                            for f in TrafficState._fields})
+    meta.setdefault("traffic_stats", {})
+    return state, stored, meta

@@ -160,6 +160,15 @@ def _pull_params(config) -> dict:
                 pull_request_cap=config.pull_request_cap)
 
 
+def _traffic_params(config) -> dict:
+    """EngineParams kwargs for the concurrent-traffic knobs (traffic.py)."""
+    return dict(traffic_values=config.traffic_values,
+                traffic_rate=config.traffic_rate,
+                node_ingress_cap=config.node_ingress_cap,
+                node_egress_cap=config.node_egress_cap,
+                traffic_stall_rounds=config.traffic_stall_rounds)
+
+
 def _engine_params(config, num_nodes: int):
     """The EngineParams a Config selects (engine/params.py) — the single
     construction every TPU run path (single-sim, origin-rank sweep, lane
@@ -182,6 +191,7 @@ def _engine_params(config, num_nodes: int):
         trace_prune_cap=config.trace_prune_cap,
         **_impair_params(config),
         **_pull_params(config),
+        **_traffic_params(config),
     )
 
 
@@ -327,6 +337,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max pull requests a peer serves per round "
                         "(<= 0 = unlimited); excess requests are counted "
                         "as capped misses")
+    p.add_argument("--traffic-values", type=int, default=1,
+                   help="concurrent CRDS value slots (traffic.py): > 1 "
+                        "switches to the M-value traffic engine — a "
+                        "deterministic stake-weighted injection schedule "
+                        "where all in-flight values share ONE active-set/"
+                        "prune/rotation state and contend for per-node "
+                        "queue budgets.  1 with both caps off (default) is "
+                        "bit-identical to the single-value simulator")
+    p.add_argument("--traffic-rate", type=int, default=1,
+                   help="new values injected per round at counter-hashed "
+                        "stake-weighted origins (traffic mode; injections "
+                        "beyond free slots are counted as dropped)")
+    p.add_argument("--node-ingress-cap", type=int, default=0,
+                   help="messages a node ACCEPTS per round across all "
+                        "in-flight values (<= 0 = unlimited); excess "
+                        "arrivals are dropped with a queue_dropped outcome")
+    p.add_argument("--node-egress-cap", type=int, default=0,
+                   help="messages a node SENDS per round across all "
+                        "in-flight values (<= 0 = unlimited); excess "
+                        "candidates defer to the next round (a send queue)")
+    p.add_argument("--traffic-stall-rounds", type=int, default=3,
+                   help="consecutive no-progress rounds before an "
+                        "unconverged value retires and frees its slot")
     p.add_argument("--influx", default="n",
                    help="Influx for reporting metrics. i for internal-metrics, "
                         "l for localhost, n for none")
@@ -493,6 +526,11 @@ def config_from_args(args) -> Config:
         pull_interval=args.pull_interval,
         pull_bloom_fp_rate=args.pull_bloom_fp_rate,
         pull_request_cap=args.pull_request_cap,
+        traffic_values=args.traffic_values,
+        traffic_rate=args.traffic_rate,
+        node_ingress_cap=args.node_ingress_cap,
+        node_egress_cap=args.node_egress_cap,
+        traffic_stall_rounds=args.traffic_stall_rounds,
         test_type=Testing.parse(args.test_type),
         num_simulations=args.num_simulations,
         step_size=StepSize.parse(args.step_size),
@@ -1885,6 +1923,11 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
             "pull_suppressed": int(agg.total_pull_suppressed),
             "pull_rescued": int(agg.total_pull_rescued),
         })
+    # queue-cap drops ride next to the hop-clamp count in every summary
+    # line (traffic runs report real counts via run_traffic; keeping the
+    # key here too means a capped run can never be mistaken for a lossless
+    # one by a dashboard reading either summary shape)
+    summary["queue_dropped"] = 0
     log.info("ALL-ORIGINS SUMMARY: %s",
              {k: v for k, v in summary.items() if k != "stats"})
     return summary
@@ -2326,6 +2369,511 @@ def _write_run_report(config, stats=None, faults=None, influx=None):
 
 
 # --------------------------------------------------------------------------
+# concurrent-traffic runs (traffic.py / engine/traffic.py — ISSUE 10)
+# --------------------------------------------------------------------------
+
+#: test types a traffic run can sweep; all four step traced EngineKnobs
+#: leaves, so every traffic sweep compiles once and is lane-eligible
+TRAFFIC_SWEEP_TYPES = (Testing.TRAFFIC_RATE, Testing.NODE_INGRESS_CAP,
+                       Testing.PACKET_LOSS, Testing.CHURN)
+
+
+def _push_sim_traffic_point(config, dp_queue, sim_iter, start_ts, it, vals):
+    if dp_queue is None:
+        return
+    from .stats.traffic import ROUND_FIELDS
+    dp = InfluxDataPoint(start_ts, sim_iter)
+    dp.create_sim_traffic_point(it, {k: vals[k] for k in ROUND_FIELDS})
+    dp_queue.push_back(dp)
+
+
+def _push_sim_traffic_summary_point(dp_queue, sim_iter, start_ts, summary):
+    if dp_queue is None:
+        return
+    dp = InfluxDataPoint(start_ts, sim_iter)
+    dp.create_sim_traffic_summary_point(summary)
+    dp_queue.push_back(dp)
+
+
+def _traffic_oracle(config, params, stakes_np):
+    """The loop-based TrafficOracle a Config selects — the engine's
+    geometry fields come off the SAME EngineParams so the two backends can
+    never disagree on k_inbound/rc widths."""
+    from .traffic import TrafficOracle
+    return TrafficOracle(
+        stakes_np, seed=config.seed, impair_seed=params.impair_seed,
+        traffic_values=params.traffic_values,
+        traffic_rate=params.traffic_rate,
+        node_ingress_cap=params.node_ingress_cap,
+        node_egress_cap=params.node_egress_cap,
+        traffic_stall_rounds=params.traffic_stall_rounds,
+        push_fanout=params.push_fanout,
+        active_set_size=params.active_set_size,
+        init_draws=params.init_draws, k_inbound=params.k_inbound,
+        received_cap=params.received_cap, rc_slots=params.rc_slots,
+        min_num_upserts=params.min_num_upserts,
+        prune_stake_threshold=params.prune_stake_threshold,
+        min_ingress_nodes=params.min_ingress_nodes,
+        probability_of_rotation=params.probability_of_rotation,
+        rot_tries=params.rot_tries, hist_bins=params.hist_bins,
+        packet_loss_rate=params.packet_loss_rate,
+        churn_fail_rate=params.churn_fail_rate,
+        churn_recover_rate=params.churn_recover_rate,
+        partition_at=params.partition_at, heal_at=params.heal_at)
+
+
+def _feed_traffic_rows(stats, config, dp_queue, sim_iter, start_ts, rows,
+                       start_it, n_it, num_nodes, lane=None):
+    """Harvested traffic rows -> TrafficStats + sim_traffic Influx points
+    (measured rounds only; the warm-up scan discards its rows)."""
+    from .stats.traffic import ROUND_FIELDS
+    from .traffic import retire_record
+    sel = (lambda arr, t: arr[t] if lane is None else arr[t, lane])
+    for t in range(n_it):
+        it = start_it + t
+        vals = {k: int(sel(rows[k], t)) for k in ROUND_FIELDS}
+        stats.feed_round(it, vals)
+        recs = []
+        ret = np.asarray(sel(rows["ret_mask"], t))
+        for m in np.nonzero(ret)[0]:
+            g = lambda name: sel(rows[name], t)[m]
+            recs.append(retire_record(
+                int(g("ret_vid")), int(g("ret_origin")), int(g("ret_birth")),
+                it, int(g("ret_holders")), num_nodes, int(g("ret_m")),
+                bool(g("ret_full")), int(g("ret_hops_sum"))))
+        if recs:
+            stats.feed_records(recs)
+        if it % 10 == 0:
+            log.info("TRAFFIC ITERATION: %s (live=%s retired=%s)", it,
+                     vals["live"], vals["retired"])
+        _push_sim_traffic_point(config, dp_queue, sim_iter, start_ts, it,
+                                vals)
+
+
+def _traffic_final_from_state(state) -> dict:
+    """End-of-run accumulator summary off a TrafficState (engine side)."""
+    return {
+        "live_at_end": int(np.asarray(state.v_live).sum()),
+        "injected": int(state.inj_acc),
+        "inject_dropped": int(state.injdrop_acc),
+        "retired": int(state.ret_acc),
+        "converged": int(state.conv_acc),
+        "deferred": int(np.asarray(state.defer_acc).sum()),
+        "queue_dropped": int(np.asarray(state.qdrop_acc).sum()),
+        "sent": int(np.asarray(state.sent_acc).sum()),
+        "recv": int(np.asarray(state.recv_acc).sum()),
+        "prunes": int(np.asarray(state.prune_acc).sum()),
+    }
+
+
+def _run_traffic_oracle_point(config, params, stakes_np, stats, dp_queue,
+                              sim_iter, start_ts):
+    """One traffic simulation on the loop-based CPU oracle."""
+    reg = get_registry()
+    reg.set_info("platform", "oracle")
+    if config.trace_dir:
+        log.warning("WARNING: traffic traces are captured by the engine; "
+                    "--trace-dir is ignored on --backend oracle")
+    if config.resume_path or config.checkpoint_path:
+        log.warning("WARNING: traffic checkpoints are written by the tpu "
+                    "backend only; --checkpoint-path/--resume ignored on "
+                    "--backend oracle")
+    with reg.span("engine/init"):
+        oracle = _traffic_oracle(config, params, stakes_np)
+    warm = config.warm_up_rounds
+    totals = {k: 0 for k in ("injected", "inject_dropped", "retired",
+                             "converged", "deferred", "queue_dropped",
+                             "sent", "recv", "prunes")}
+    hb = Heartbeat(config.gossip_iterations, label="traffic rounds",
+                   unit="iter")
+    for it in range(config.gossip_iterations):
+        t_it = time.perf_counter()
+        tr = oracle.run_round(it)
+        if it >= warm:
+            reg.record("engine/rounds", time.perf_counter() - t_it)
+            vals = {k: getattr(tr, k) for k in
+                    ("injected", "inject_dropped", "live", "sends",
+                     "deferred", "failed_target", "suppressed", "dropped",
+                     "arrived", "queue_dropped", "accepted", "delivered",
+                     "redundant", "prunes_sent", "retired", "converged",
+                     "hop_clamped", "qdepth_max", "inflow_max")}
+            stats.feed_round(it, vals)
+            stats.feed_records(tr.records)
+            totals["injected"] += tr.injected
+            totals["inject_dropped"] += tr.inject_dropped
+            totals["retired"] += tr.retired
+            totals["converged"] += tr.converged
+            totals["deferred"] += tr.deferred
+            totals["queue_dropped"] += tr.queue_dropped
+            totals["sent"] += tr.sends
+            totals["recv"] += tr.accepted
+            totals["prunes"] += tr.prunes_sent
+            _push_sim_traffic_point(config, dp_queue, sim_iter, start_ts,
+                                    it, vals)
+        if it % 10 == 0:
+            hb.beat(it)
+    live = sum(sl is not None for sl in oracle.slots)
+    stats.feed_final(dict(live_at_end=live, **totals))
+
+
+def _run_traffic_tpu_point(config, params, stakes_np, index, stats,
+                           dp_queue, sim_iter, start_ts):
+    """One traffic simulation on the JAX engine: warm-up as one fused
+    scan, measured rounds harvested in blocks; v6 traffic checkpoints
+    (state + serialized stats) make it preemption-safe."""
+    import jax
+
+    from .engine import make_cluster_tables
+    from .engine.traffic import (device_traffic_tables, init_traffic_state,
+                                 run_traffic_rounds)
+
+    reg = get_registry()
+    _enable_compilation_cache(config)
+    N = len(index)
+    with reg.span("engine/tables"):
+        tables = make_cluster_tables(stakes_np)
+        ttables = device_traffic_tables(stakes_np)
+    reg.set_info("platform", jax.devices()[0].platform)
+
+    tracer = None
+    if config.trace_dir:
+        from .obs.trace import TraceWriter, traffic_block_from_engine_rows
+        if config.gossip_iterations <= config.warm_up_rounds:
+            log.warning("WARNING: --trace-dir set but no measured rounds; "
+                        "no trace written")
+        else:
+            tracer = TraceWriter(
+                config.trace_dir, backend="tpu", num_nodes=N,
+                push_fanout=min(params.push_fanout, params.active_set_size),
+                active_set_size=params.active_set_size,
+                prune_cap=params.split()[0].traffic_prune_cap,
+                traffic_slots=params.traffic_values,
+                origins=[], origin_pubkeys=[], seed=config.seed,
+                warm_up_rounds=config.warm_up_rounds,
+                iterations=config.gossip_iterations, config=config)
+
+    start_iter = 0
+    if config.resume_path:
+        from .checkpoint import restore_traffic_state
+        with reg.span("checkpoint/restore"):
+            state, _, meta = restore_traffic_state(config.resume_path,
+                                                   params)
+        stats.load_state_dict(meta.get("traffic_stats") or {})
+        start_iter = int(meta.get("iteration", 0))
+        log.info("Resumed traffic state from %s at iteration %s "
+                 "(%s committed round(s), %s record(s))",
+                 config.resume_path, start_iter, len(stats.iterations),
+                 len(stats.records))
+        if start_iter >= config.gossip_iterations:
+            log.warning("WARNING: checkpoint already at iteration %s >= "
+                        "--iterations %s; nothing to run", start_iter,
+                        config.gossip_iterations)
+            stats.feed_final(_traffic_final_from_state(state))
+            return
+    else:
+        log.info("Building the shared traffic active set....")
+        with reg.span("engine/init"):
+            state = init_traffic_state(stakes_np, params, config.seed)
+            jax.block_until_ready(state)
+
+    last_save = [float("-inf")]
+
+    def _save_checkpoint(iteration, force=True):
+        if not config.checkpoint_path:
+            return
+        now = time.monotonic()
+        if (not force and config.checkpoint_every_s > 0
+                and now - last_save[0] < config.checkpoint_every_s):
+            return
+        from .checkpoint import save_traffic_state
+        with reg.span("checkpoint/save"):
+            save_traffic_state(config.checkpoint_path, state, params,
+                               config, iteration=iteration,
+                               traffic_stats=stats.state_dict())
+        last_save[0] = now
+
+    warm = min(config.warm_up_rounds, config.gossip_iterations)
+    if start_iter < warm:
+        cm, _ = _engine_call_span(reg, fallback="engine/warmup")
+        with cm:
+            state, _ = _dispatch_supervised(
+                config, "traffic-warmup",
+                lambda st: _blocked(run_traffic_rounds(
+                    params, tables, ttables, st, warm - start_iter,
+                    start_it=start_iter)), state)
+        _save_checkpoint(warm)
+    measured = config.gossip_iterations - warm
+    done = max(0, start_iter - warm)
+    hb = Heartbeat(measured, label=f"traffic sim {sim_iter} measured "
+                   "rounds", unit="iter")
+    while done < measured:
+        n_it = min(HARVEST_BLOCK, measured - done)
+        start_it = warm + done
+        t_blk = time.perf_counter()
+        cm, counted = _engine_call_span(reg)
+
+        def _block_dispatch(st):
+            st, rws = run_traffic_rounds(params, tables, ttables, st, n_it,
+                                         start_it=start_it,
+                                         trace=tracer is not None)
+            return st, jax.tree_util.tree_map(np.asarray, rws)
+
+        with cm:
+            state, rows = _dispatch_supervised(
+                config, f"traffic-block-{start_it}", _block_dispatch, state)
+        blk_wall = time.perf_counter() - t_blk
+        if counted:
+            reg.add("origin_iters", n_it)
+            reg.add("messages_delivered", int(rows["accepted"].sum()))
+        if tracer is not None:
+            from .obs.trace import traffic_block_from_engine_rows
+            with reg.span("trace/write"):
+                seg = tracer.add_block(start_it,
+                                       traffic_block_from_engine_rows(rows))
+            _push_sim_trace_point(dp_queue, sim_iter, start_ts, seg)
+        with reg.span("stats/harvest"):
+            _feed_traffic_rows(stats, config, dp_queue, sim_iter, start_ts,
+                               rows, start_it, n_it, N)
+        done += n_it
+        hb.beat(done)
+        _push_sim_perf_point(dp_queue, sim_iter, start_ts, blk_wall, n_it, 1)
+        _save_checkpoint(warm + done, force=False)
+        if resilience.shutdown_requested():
+            stats.feed_final(_traffic_final_from_state(state))
+            _save_checkpoint(warm + done)
+            if tracer is not None:
+                tracer.finalize()
+            raise ResumableInterrupt(
+                f"traffic checkpoint saved at iteration {warm + done}; "
+                f"resume with --resume {config.checkpoint_path}"
+                if config.checkpoint_path else
+                f"traffic run stopped at iteration {warm + done} with no "
+                f"--checkpoint-path; a re-run starts from scratch")
+    if tracer is not None:
+        tracer.finalize()
+        log.info("traffic trace written to %s", config.trace_dir)
+    stats.feed_final(_traffic_final_from_state(state))
+    _save_checkpoint(config.gossip_iterations)
+
+
+def _log_traffic_summary(label, s):
+    """The traffic run summary line: per-value outcomes + queue-cap drops
+    surfaced alongside the hop-clamp count (a capped run must never read
+    as lossless)."""
+    log.info(
+        "TRAFFIC SUMMARY%s: %s values injected (%s dropped at injection), "
+        "%s retired (%s converged, %s stranded, %s unfinished) | "
+        "coverage mean %.4f | latency mean %.2f p90 %.2f rounds | "
+        "value RMR mean %.3f | queue: %s deferred (max depth %s), "
+        "%s dropped | loss %s, hop_clamped %s",
+        label, s["values_injected"], s["inject_dropped"],
+        s["values_retired"], s["values_converged"], s["values_stranded"],
+        s["values_unfinished"], s["value_coverage_mean"],
+        s["value_latency_mean"], s["value_latency_p90"],
+        s["value_rmr_mean"], s["queue_deferred"], s["qdepth_max"],
+        s["queue_dropped"], s["loss_dropped"], s["hop_clamped"])
+
+
+def _traffic_lane_blocker(config: Config, n_points: int):
+    """None when --sweep-lanes can serve this traffic sweep, else the
+    reason (mirrors _lane_sweep_blocker)."""
+    if config.backend != "tpu":
+        return "lane mode requires --backend tpu"
+    if n_points < 2:
+        return "nothing to batch (num_simulations < 2)"
+    if config.test_type not in TRAFFIC_SWEEP_TYPES:
+        return (f"--test-type {config.test_type.value} does not step a "
+                f"traffic-sweepable knob")
+    if config.trace_dir:
+        return "--trace-dir captures one sim's event stream"
+    if config.checkpoint_path or config.resume_path:
+        return "traffic checkpoints cover single runs only"
+    if config.gossip_iterations <= config.warm_up_rounds:
+        return "no measured rounds (iterations <= warm-up-rounds)"
+    return None
+
+
+def _run_traffic_lane_sweep(config, point_cfgs, accounts, collection,
+                            dp_queue, start_ts, point_starts):
+    """Traffic knob sweep as lane-batched device programs: K stepped knob
+    vectors vmapped into ceil(K/--sweep-lanes) batched scans, each lane
+    bit-identical to its serial run (engine/lanes.py contract)."""
+    import jax
+
+    from .engine import make_cluster_tables
+    from .engine.lanes import stack_knobs
+    from .engine.params import merge_lane_statics
+    from .engine.traffic import (broadcast_traffic_state,
+                                 device_traffic_tables, init_traffic_state,
+                                 run_traffic_lanes, traffic_lane_state)
+    from .stats.traffic import TrafficStats
+
+    reg = get_registry()
+    _enable_compilation_cache(config)
+    index = NodeIndex.from_stakes(accounts)
+    stakes_np = index.stakes.astype(np.int64)
+    N = len(index)
+    params_list = [_engine_params(c, N).validate() for c in point_cfgs]
+    splits = [p.split() for p in params_list]
+    merged = merge_lane_statics(s for s, _ in splits)
+    knob_list = [k for _, k in splits]
+    from .engine.lanes import check_lane_knobs
+    check_lane_knobs(merged, knob_list)
+    with reg.span("engine/tables"):
+        tables = make_cluster_tables(stakes_np)
+        ttables = device_traffic_tables(stakes_np)
+    reg.set_info("platform", jax.devices()[0].platform)
+    K = len(point_cfgs)
+    lanes = max(1, min(config.sweep_lanes, K))
+    reg.set_info("sweep_lanes", lanes)
+    reg.set_info("lane_batches", (K + lanes - 1) // lanes)
+    warm = min(config.warm_up_rounds, config.gossip_iterations)
+    measured = config.gossip_iterations - warm
+    base_state = init_traffic_state(stakes_np, params_list[0], config.seed)
+    hb = Heartbeat((K + lanes - 1) // lanes, label="traffic lane sweep",
+                   unit="batch")
+    done_batches = 0
+    for lo in range(0, K, lanes):
+        hi = min(lo + lanes, K)
+        width = hi - lo
+        batch_knobs = knob_list[lo:hi]
+        if width < lanes:
+            # tail batch: pad with the last point's knobs to keep ONE
+            # compiled lane width; padded lanes are never harvested
+            batch_knobs = batch_knobs + [batch_knobs[-1]] * (lanes - width)
+        stacked = stack_knobs(batch_knobs)
+        cm, _ = _engine_call_span(reg, fallback="engine/rounds")
+
+        # broadcast INSIDE the supervised fn: run_traffic_lanes donates
+        # its lane state, so every watchdog retry / CPU-fallback attempt
+        # must rebuild fresh lanes from the (host-snapshotted) base
+        def _batch_dispatch(base):
+            sts = broadcast_traffic_state(base, lanes)
+            if warm > 0:
+                sts, _ = run_traffic_lanes(merged, tables, ttables,
+                                           sts, stacked, warm)
+            sts, rws = run_traffic_lanes(merged, tables, ttables,
+                                         sts, stacked, measured,
+                                         start_it=warm)
+            return sts, jax.tree_util.tree_map(np.asarray, rws)
+
+        with cm:
+            lane_st, lrows = _dispatch_supervised(
+                config, f"traffic-lane-batch-{lo // lanes}",
+                _batch_dispatch, base_state)
+        for lane in range(width):
+            i = lo + lane
+            stats = TrafficStats()
+            _feed_traffic_rows(stats, point_cfgs[i], dp_queue, i, start_ts,
+                               lrows, warm, measured, N, lane=lane)
+            stats.feed_final(_traffic_final_from_state(
+                traffic_lane_state(lane_st, lane)))
+            _push_sim_traffic_summary_point(dp_queue, i, start_ts,
+                                            stats.summary())
+            collection.push(point_starts[i], stats)
+        done_batches += 1
+        hb.beat(done_batches)
+        check_interrupt(None)
+    hb.finish()
+
+
+def run_traffic(config: Config, json_rpc_url: str, dp_queue, start_ts: str,
+                collection=None):
+    """The concurrent-traffic run path (--traffic-values / queue caps):
+    single runs, serial sweeps over TRAFFIC_SWEEP_TYPES, and lane-batched
+    sweeps under --sweep-lanes.  Returns the run-report summary dict;
+    ``collection`` (a TrafficStatsCollection) receives the per-point
+    TrafficStats when a caller wants the full parity surface (tests,
+    tools/traffic_smoke.py)."""
+    from .stats.traffic import TrafficStats, TrafficStatsCollection
+
+    is_sweep = (config.test_type in TRAFFIC_SWEEP_TYPES
+                and config.num_simulations > 1)
+    n_points = config.num_simulations if is_sweep else 1
+    if is_sweep and (config.checkpoint_path or config.resume_path):
+        # every point would share ONE state file: point k+1 overwrites
+        # point k's checkpoint and --resume would replay one point's
+        # mid-run state into all of them
+        raise ValueError(
+            "--checkpoint-path/--resume cover single traffic runs only; "
+            "a traffic sweep has no per-point journal yet — drop the "
+            "flag or run the sweep points as separate single runs")
+    if collection is None:
+        collection = TrafficStatsCollection()
+    point_cfgs, point_starts = [], []
+    for i in range(n_points):
+        c, start = (_stepped_sweep_config(config, i, [config.origin_rank])
+                    if is_sweep else (config, 0.0))
+        if is_sweep and config.trace_dir:
+            # one event stream per point (the PR 3 generic-sweep layout)
+            c = c.stepped(trace_dir=os.path.join(config.trace_dir,
+                                                 f"sim{i:03d}"))
+        point_cfgs.append(c)
+        point_starts.append(start if is_sweep else 0.0)
+
+    lane_mode = False
+    if config.sweep_lanes > 0:
+        blocker = _traffic_lane_blocker(config, n_points)
+        if blocker is None:
+            lane_mode = True
+        else:
+            log.warning("WARNING: --sweep-lanes %s ignored (%s); running "
+                        "the serial traffic sweep", config.sweep_lanes,
+                        blocker)
+
+    accounts, _ = load_cluster_accounts(config, json_rpc_url)
+    if lane_mode:
+        _run_traffic_lane_sweep(config, point_cfgs, accounts, collection,
+                                dp_queue, start_ts, point_starts)
+    else:
+        index = NodeIndex.from_stakes(accounts)
+        stakes_np = index.stakes.astype(np.int64)
+        for i, c in enumerate(point_cfgs):
+            log.info("##### TRAFFIC SIMULATION: %s (%s) #####", i,
+                     c.test_type)
+            params = _engine_params(c, len(index)).validate()
+            stats = TrafficStats()
+            if c.backend == "oracle":
+                _run_traffic_oracle_point(c, params, stakes_np, stats,
+                                          dp_queue, i, start_ts)
+            else:
+                _run_traffic_tpu_point(c, params, stakes_np, index, stats,
+                                       dp_queue, i, start_ts)
+            _push_sim_traffic_summary_point(dp_queue, i, start_ts,
+                                            stats.summary())
+            collection.push(point_starts[i], stats)
+            check_interrupt(None)
+
+    summaries = collection.summaries()
+    for i, s in enumerate(summaries):
+        _log_traffic_summary(f" (point {i})" if n_points > 1 else "", s)
+    if n_points > 1:
+        # the report's stats.traffic must describe the WHOLE run: merge
+        # every point's rounds/records into one TrafficStats so counters
+        # sum and latency/coverage/RMR aggregate over all retired values
+        # (per-point summaries stay in stats.traffic_points)
+        agg = TrafficStats()
+        for st in collection.collection:
+            agg.iterations.extend(st.iterations)
+            for k in agg.rounds:
+                agg.rounds[k].extend(st.rounds[k])
+            agg.records.extend(st.records)
+        agg.final = {"live_at_end": sum(
+            int(st.final.get("live_at_end", 0))
+            for st in collection.collection)}
+        out = agg.summary()
+    else:
+        out = dict(summaries[-1]) if summaries else {}
+        out.pop("point", None)
+    return {
+        "traffic": out,
+        "traffic_points": summaries if n_points > 1 else [],
+        "num_points": n_points,
+        "sweep_lanes": config.sweep_lanes if lane_mode else 0,
+    }
+
+
+# --------------------------------------------------------------------------
 # sweep dispatch (gossip_main.rs:774-951)
 # --------------------------------------------------------------------------
 
@@ -2383,6 +2931,16 @@ def _stepped_sweep_config(config: Config, i: int, origin_ranks):
         # executable (PR 4 invariant, tests/test_pull.py)
         v = config.pull_fanout + i * config.step_size.as_int()
         return config.stepped(pull_fanout=v), float(config.pull_fanout)
+    if tt == Testing.TRAFFIC_RATE:
+        # traced traffic knob (traffic.py): the injection rate steps
+        # within the static traffic_values slot capacity, compile-free
+        v = config.traffic_rate + i * config.step_size.as_int()
+        return config.stepped(traffic_rate=v), float(config.traffic_rate)
+    if tt == Testing.NODE_INGRESS_CAP:
+        # traced traffic knob: per-node ingress queue budget
+        v = config.node_ingress_cap + i * config.step_size.as_int()
+        return config.stepped(node_ingress_cap=v), \
+            float(config.node_ingress_cap)
     return config, 0.0  # NO_TEST
 
 
@@ -2512,8 +3070,11 @@ def main(argv=None) -> int:
                   "stake), got: %s", origin_ranks)
         return 1
 
-    # origin-rank count validation (gossip_main.rs:706-716)
-    if len(origin_ranks) < config.num_simulations:
+    # origin-rank count validation (gossip_main.rs:706-716); traffic runs
+    # inject their own stake-weighted origins, so the rank list is moot
+    if config.traffic_on:
+        pass
+    elif len(origin_ranks) < config.num_simulations:
         log.error("ERROR: not enough origin ranks provided for "
                   "num_simulations! origin_ranks: %s, num_simulations: %s",
                   len(origin_ranks), config.num_simulations)
@@ -2534,6 +3095,58 @@ def main(argv=None) -> int:
                   "--gossip-mode (pull or push-pull); mode is push, so "
                   "every sweep point would be identical")
         return 1
+
+    if config.traffic_values < 1:
+        log.error("ERROR: --traffic-values must be >= 1 (the default 1 "
+                  "with both caps off IS the plain single-value "
+                  "simulator — there is no separate off value)")
+        return 1
+    if config.traffic_on and config.traffic_rate < 0:
+        log.error("ERROR: --traffic-rate must be >= 0")
+        return 1
+    if config.traffic_on and config.traffic_stall_rounds < 1:
+        log.error("ERROR: --traffic-stall-rounds must be >= 1 (a value "
+                  "needs at least one no-progress round to retire)")
+        return 1
+    if (config.test_type in (Testing.TRAFFIC_RATE, Testing.NODE_INGRESS_CAP)
+            and not config.traffic_on):
+        log.error("ERROR: --test-type %s requires the traffic subsystem "
+                  "(--traffic-values > 1 or a queue cap); every sweep "
+                  "point would be identical otherwise",
+                  config.test_type.value)
+        return 1
+    if config.traffic_on:
+        if config.all_origins:
+            log.error("ERROR: --all-origins and concurrent traffic are "
+                      "separate workload modes; traffic injects its own "
+                      "stake-weighted origins")
+            return 1
+        if config.has_pull:
+            log.error("ERROR: the traffic subsystem models concurrent "
+                      "PUSH streams; --gossip-mode %s is not supported "
+                      "with it (future work)", config.gossip_mode)
+            return 1
+        allowed = TRAFFIC_SWEEP_TYPES + (Testing.NO_TEST,)
+        if config.test_type not in allowed:
+            log.error("ERROR: --test-type %s is not runnable in traffic "
+                      "mode; traffic sweeps: %s", config.test_type.value,
+                      ", ".join(t.value for t in TRAFFIC_SWEEP_TYPES))
+            return 1
+        is_traffic_sweep = (config.test_type in TRAFFIC_SWEEP_TYPES
+                            and config.num_simulations > 1)
+        if is_traffic_sweep and (config.checkpoint_path
+                                 or config.resume_path):
+            log.error("ERROR: --checkpoint-path/--resume cover single "
+                      "traffic runs only; a traffic sweep has no "
+                      "per-point journal yet — drop the flag or run the "
+                      "sweep points as separate single runs")
+            return 1
+        if config.num_simulations > 1 and not is_traffic_sweep:
+            log.warning("WARNING: --num-simulations %s ignored in traffic "
+                        "mode: --test-type %s does not step a "
+                        "traffic-sweepable knob (traffic sweeps: %s)",
+                        config.num_simulations, config.test_type.value,
+                        ", ".join(t.value for t in TRAFFIC_SWEEP_TYPES))
 
     if config.gossip_iterations <= config.warm_up_rounds:
         log.warning("WARNING: Gossip Iterations (%s) <= Warm Up Rounds (%s). "
@@ -2563,9 +3176,13 @@ def main(argv=None) -> int:
             dp_queue, spool_path=config.influx_spool)
 
     collection = None
+    traffic_summary = None
     try:
         with signal_guard():
-            if config.all_origins:
+            if config.traffic_on:
+                traffic_summary = run_traffic(config, args.json_rpc_url,
+                                              dp_queue, start_ts)
+            elif config.all_origins:
                 if config.backend != "tpu":
                     log.error("--all-origins requires --backend tpu")
                     return 1
@@ -2596,6 +3213,12 @@ def main(argv=None) -> int:
                     f"; resume with --resume {ckpt}" if ckpt else
                     " (no --checkpoint-path: a re-run starts from scratch)")
         return RESUMABLE_EXIT_CODE
+
+    if config.traffic_on:
+        influx_stats = _drain_influx(dp_queue, influx_thread)
+        _write_run_report(config, stats=traffic_summary,
+                          influx=influx_stats)
+        return 0
 
     if config.all_origins:
         influx_stats = _drain_influx(dp_queue, influx_thread)

@@ -22,6 +22,8 @@ class Testing(enum.Enum):
     PACKET_LOSS = "packet-loss"
     CHURN = "churn"
     PULL_FANOUT = "pull-fanout"
+    TRAFFIC_RATE = "traffic-rate"
+    NODE_INGRESS_CAP = "node-ingress-cap"
     NO_TEST = "no-test"
 
     def __str__(self):
@@ -37,6 +39,8 @@ class Testing(enum.Enum):
             Testing.PACKET_LOSS: "PacketLoss",
             Testing.CHURN: "Churn",
             Testing.PULL_FANOUT: "PullFanout",
+            Testing.TRAFFIC_RATE: "TrafficRate",
+            Testing.NODE_INGRESS_CAP: "NodeIngressCap",
             Testing.NO_TEST: "NoTest",
         }[self]
 
@@ -115,6 +119,17 @@ class Config:
     pull_interval: int = 1          # rounds between pull exchanges
     pull_bloom_fp_rate: float = 0.1  # bloom false-positive probability
     pull_request_cap: int = 0       # requests served per peer (<=0 = no cap)
+
+    # Concurrent traffic (traffic.py; both backends, bit-equivalent
+    # decisions under the shared seed).  traffic_values == 1 with both
+    # queue caps at 0 keeps every output bit-identical to the
+    # single-value simulator (the subsystem is fully gated out):
+    traffic_values: int = 1         # concurrent value slots (static M)
+    traffic_rate: int = 1           # new values injected per round
+    node_ingress_cap: int = 0       # msgs accepted/node/round (<=0 = off)
+    node_egress_cap: int = 0        # msgs sent/node/round (<=0 = off)
+    traffic_stall_rounds: int = 3   # no-progress rounds before a value
+                                    # retires un-converged
 
     # TPU-framework extensions (not in the reference):
     backend: str = "tpu"            # "tpu" | "oracle"
@@ -203,3 +218,12 @@ class Config:
         with it the pull counters/series (a PULL_FANOUT sweep requires a
         pull mode; the CLI rejects it otherwise)."""
         return self.gossip_mode != "push"
+
+    @property
+    def traffic_on(self) -> bool:
+        """The concurrent-traffic subsystem is engaged (traffic.py):
+        more than one value slot, or a queue cap constraining the
+        single-value stream.  Mirrors EngineParams.has_traffic — False
+        keeps the run on the unmodified single-value paths."""
+        return (self.traffic_values > 1 or self.node_ingress_cap > 0
+                or self.node_egress_cap > 0)

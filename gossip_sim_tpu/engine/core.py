@@ -358,6 +358,10 @@ def _check_knob_gates(static: EngineStatic, kn: EngineKnobs) -> None:
             "has_partition": int(kn.partition_at) >= 0,
             "has_fail": (int(kn.fail_at) >= 0
                          and float(kn.fail_fraction) > 0.0),
+            # queue caps only act inside the traffic engine (traffic.py);
+            # with traffic_slots == 0 they would be silently inert
+            "has_traffic": (int(kn.node_ingress_cap) > 0
+                            or int(kn.node_egress_cap) > 0),
         }
     except Exception:   # traced leaves have no concrete value here
         return

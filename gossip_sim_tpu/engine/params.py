@@ -95,6 +95,13 @@ class EngineKnobs(NamedTuple):
     pull_interval: np.int32               # rounds between pull exchanges
     pull_bloom_fp_rate: np.float64        # bloom false-positive probability
     pull_request_cap: np.int32            # served requests per peer (<=0 off)
+    # concurrent-traffic knobs (traffic.py); the traffic engine itself is
+    # gated on the static ``traffic_slots`` — these only shape it, so a
+    # traffic-rate or queue-cap sweep reuses one compiled executable
+    traffic_rate: np.int32                # values injected per round
+    node_ingress_cap: np.int32            # msgs accepted/node/round (<=0 off)
+    node_egress_cap: np.int32             # msgs sent/node/round (<=0 off)
+    traffic_stall_rounds: np.int32        # no-progress rounds before retire
 
 
 class EngineStatic(NamedTuple):
@@ -130,6 +137,11 @@ class EngineStatic(NamedTuple):
     # when the mode has no pull phase).
     gossip_mode: str = "push"
     pull_slots: int = 0
+    # Concurrent-traffic geometry (traffic.py / engine/traffic.py):
+    # ``traffic_slots`` is the static M-value slot capacity (the state's
+    # value axis).  0 = the traffic subsystem is OFF and no traffic code
+    # exists in any compiled graph — the M=1/caps-off bit-identity gate.
+    traffic_slots: int = 0
 
     @property
     def num_buckets(self) -> int:
@@ -138,6 +150,10 @@ class EngineStatic(NamedTuple):
     @property
     def has_impairments(self) -> bool:
         return self.has_loss or self.has_churn or self.has_partition
+
+    @property
+    def has_traffic(self) -> bool:
+        return self.traffic_slots > 0
 
     @property
     def has_pull(self) -> bool:
@@ -151,6 +167,15 @@ class EngineStatic(NamedTuple):
     def prune_cap(self) -> int:
         return _resolve_prune_cap(self.trace_prune_cap, self.num_nodes,
                                   self.rc_slots)
+
+    @property
+    def traffic_prune_cap(self) -> int:
+        """Flight-recorder prune-pair capture width per (value, round) in
+        traffic mode: the single-origin cap bounded to 4*N — the capture
+        buffer carries a whole value axis, and per-value prune bursts are
+        far smaller than the all-prunes-for-one-origin bursts the 16*N
+        default was sized for.  Truncation is counted, never silent."""
+        return min(self.prune_cap, 4 * self.num_nodes)
 
     @property
     def k_inbound(self) -> int:
@@ -250,6 +275,23 @@ class EngineParams(NamedTuple):
                                      # max(8, pull_fanout) so fanout sweeps
                                      # within 8 compile once)
 
+    # Concurrent-traffic knobs (traffic.py).  ``traffic_values`` is the
+    # static M-value slot capacity; with the default 1 AND both queue caps
+    # off the traffic subsystem is fully gated out and the simulator is
+    # bit-identical to the pre-traffic engine.  The numeric knobs are
+    # traced (EngineKnobs), so traffic-rate / queue-cap sweeps reuse one
+    # compiled executable; every traffic decision is a stateless counter
+    # hash shared bit-exactly with the oracle's TrafficOracle.
+    traffic_values: int = 1          # concurrent value slots (static M)
+    traffic_rate: int = 1            # new values injected per round
+    node_ingress_cap: int = 0        # msgs accepted per node per round
+                                     # across ALL values (<= 0 = no cap)
+    node_egress_cap: int = 0         # msgs sent per node per round across
+                                     # ALL values (<= 0 = no cap; excess
+                                     # candidates defer to the next round)
+    traffic_stall_rounds: int = 3    # consecutive no-progress rounds
+                                     # before a value retires un-converged
+
     # Dense-shape knobs (TPU formulation only; see engine/core.py for the
     # documented divergences they introduce):
     rc_slots: int = 64      # physical received-cache slots per (origin, node)
@@ -285,6 +327,15 @@ class EngineParams(NamedTuple):
     @property
     def has_churn(self) -> bool:
         return self.churn_fail_rate > 0.0 or self.churn_recover_rate > 0.0
+
+    @property
+    def has_traffic(self) -> bool:
+        """True when the concurrent-traffic subsystem (traffic.py) is
+        engaged: more than one value slot, or a queue cap constraining the
+        single-value stream.  False = the compiled graphs carry zero
+        traffic code (the M=1/caps-off bit-identity contract)."""
+        return (self.traffic_values > 1 or self.node_ingress_cap > 0
+                or self.node_egress_cap > 0)
 
     @property
     def has_pull(self) -> bool:
@@ -338,6 +389,7 @@ class EngineParams(NamedTuple):
             has_partition=self.partition_at >= 0,
             gossip_mode=self.gossip_mode,
             pull_slots=self.pull_slots_resolved if self.has_pull else 0,
+            traffic_slots=self.traffic_values if self.has_traffic else 0,
         )
 
     def knob_values(self) -> EngineKnobs:
@@ -359,6 +411,10 @@ class EngineParams(NamedTuple):
             pull_interval=np.int32(max(1, self.pull_interval)),
             pull_bloom_fp_rate=np.float64(self.pull_bloom_fp_rate),
             pull_request_cap=np.int32(self.pull_request_cap),
+            traffic_rate=np.int32(self.traffic_rate),
+            node_ingress_cap=np.int32(self.node_ingress_cap),
+            node_egress_cap=np.int32(self.node_egress_cap),
+            traffic_stall_rounds=np.int32(max(1, self.traffic_stall_rounds)),
         )
 
     def split(self) -> tuple[EngineStatic, EngineKnobs]:
@@ -392,4 +448,16 @@ class EngineParams(NamedTuple):
             assert self.pull_fanout <= self.pull_slots_resolved, (
                 "pull_fanout exceeds the static pull_slots width — raise "
                 "EngineParams.pull_slots")
+        assert self.traffic_values >= 1, "traffic_values must be >= 1"
+        if self.has_traffic:
+            assert self.traffic_rate >= 0, "traffic_rate must be >= 0"
+            assert self.traffic_stall_rounds >= 1, (
+                "traffic_stall_rounds must be >= 1")
+            assert self.gossip_mode == "push", (
+                "the traffic subsystem models concurrent PUSH streams; "
+                "pull modes are not supported with traffic_values > 1 or "
+                "queue caps (future work)")
+            assert not (self.fail_at >= 0 and self.fail_fraction > 0.0), (
+                "one-shot fail_at uses PRNG draws the traffic oracle "
+                "cannot replay; use churn_fail_rate with traffic instead")
         return self

@@ -1,0 +1,772 @@
+"""The concurrent-traffic round as sort-routed dense-array kernels.
+
+Implements the traffic model specified in :mod:`gossip_sim_tpu.traffic`
+on the TPU engine: an M-slot **value axis** whose in-flight values all
+push through ONE shared active set (one rotation schedule, one churn
+mask) while keeping per-value prune bits and received-cache scoring, with
+per-node ingress/egress queue caps creating cross-value contention.
+
+The architecture mirrors ``engine/core.py round_step`` — every cross-node
+data movement is a sort — but the batch axis is the value slot ``V``
+instead of the origin ``O``, and propagation is **one hop per round**
+(every holder pushes each round) instead of a full BFS, which is what
+makes per-round queue budgets meaningful:
+
+* candidate compaction (first F valid shared-set slots per (value,
+  sender)) is verb 1's slot-key sort with a leading V axis;
+* the **egress budget** is a plain exclusive cumsum per sender over the
+  value-major candidate order (no sort needed);
+* the **ingress budget** ranks all arrived messages of the round in one
+  flat ``(target, value-major arrival order)`` sort across the whole
+  value axis — the cross-value contention point;
+* per-(value, target) inbound ranking, received-cache merge, prune decide
+  and prune apply are verbatim verb 2-4 adaptations with ``O -> V``;
+* the shared rotation is verb 5 without the origin axis, driven by
+  counter-hash uniforms (traffic.py salts) instead of the PRNG — which is
+  why the TrafficOracle can be bit-exact with rotation ON.
+
+Every stochastic decision consumes the stateless counter hashes defined
+in ``traffic.py``, so ``TrafficOracle`` (loop-based, independent
+formulation) must match this engine bit-for-bit under packet loss +
+churn (tests/test_traffic.py locks 1k nodes, M >= 16).
+
+Traffic knobs (injection rate, queue caps, stall window) are traced
+:class:`EngineKnobs` leaves: a traffic-rate or cap sweep compiles once,
+and ``run_traffic_lanes`` vmaps the round over a stacked (state, knobs)
+lane axis exactly like ``engine/lanes.py`` does for the single-value
+engine.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..faults import (SALT_CHURN, edge_u32_arr, node_u32_arr,
+                      rate_threshold_arr, round_basis_arr)
+from ..traffic import (SALT_TRAFFIC_LOSS, SALT_TRAFFIC_OCLASS,
+                       SALT_TRAFFIC_OMEMBER, SALT_TRAFFIC_RCLASS,
+                       SALT_TRAFFIC_RMEMBER, SALT_TRAFFIC_ROT,
+                       TRAFFIC_ACCEPTED, TRAFFIC_DEFERRED, TRAFFIC_DROPPED,
+                       TRAFFIC_FAILED_TARGET, TRAFFIC_QUEUE_DROPPED,
+                       TRAFFIC_SUPPRESSED, TrafficTables,
+                       build_shared_active_set, class_draw_arr,
+                       traffic_tables, u01_arr, value_basis_arr)
+from .core import (BIG, INF, ClusterTables, _lookup, _note_compile_accounting,
+                   _pack_base, _rank_in_run, _split_params)
+from .params import EngineKnobs
+
+__all__ = [
+    "TrafficState", "init_traffic_state", "traffic_round_step",
+    "run_traffic_rounds", "run_traffic_lanes", "broadcast_traffic_state",
+    "device_traffic_tables", "traffic_compiled_cache_size",
+    "clear_traffic_compile_cache",
+]
+
+
+class TrafficState(NamedTuple):
+    """The carried pytree of one traffic simulation (shared network +
+    M value slots).  ``V`` = EngineStatic.traffic_slots."""
+
+    active: jax.Array      # [N, S] i32 the ONE shared active set (N = empty)
+    failed: jax.Array      # [N]   bool churn failure mask
+    next_vid: jax.Array    # []    i32 monotone global value-id counter
+    v_live: jax.Array      # [V]   bool slot holds an in-flight value
+    v_vid: jax.Array       # [V]   i32 value id (-1 = free slot)
+    v_origin: jax.Array    # [V]   i32 injection origin (N = free)
+    v_birth: jax.Array     # [V]   i32 injection round
+    v_stall: jax.Array     # [V]   i32 consecutive no-progress rounds
+    v_holder: jax.Array    # [V, N] bool node holds the value
+    v_hop: jax.Array       # [V, N] i32 delivery hop (-1 = unreached)
+    v_m: jax.Array         # [V]   i32 accepted msgs + prunes (RMR numerator)
+    pruned: jax.Array      # [V, N, S] bool per-value prune bits on the
+                           #           SHARED active-set slots
+    rc_src: jax.Array      # [V, N, C] i32 received-cache peers (N = empty)
+    rc_score: jax.Array    # [V, N, C] i32
+    rc_shi: jax.Array      # [V, N, C] i32
+    rc_slo: jax.Array      # [V, N, C] i32
+    rc_upserts: jax.Array  # [V, N] i32
+    # measured-round accumulators (checkpoint-carried, resume-exact)
+    inj_acc: jax.Array     # [] i32 values injected
+    injdrop_acc: jax.Array  # [] i32 injections dropped (slot table full)
+    ret_acc: jax.Array     # [] i32 values retired
+    conv_acc: jax.Array    # [] i32 retired with full coverage
+    defer_acc: jax.Array   # [N] i32 egress-cap deferrals per sender
+    qdrop_acc: jax.Array   # [N] i32 ingress-cap drops per receiver
+    sent_acc: jax.Array    # [N] i32 wire messages per sender
+    recv_acc: jax.Array    # [N] i32 accepted messages per receiver
+    prune_acc: jax.Array   # [N] i32 prune messages per pruner
+
+
+def device_traffic_tables(stakes) -> TrafficTables:
+    """Host tables -> device-resident pytree (pass into the jitted scan)."""
+    t = traffic_tables(np.asarray(stakes, dtype=np.int64))
+    return TrafficTables(*(jnp.asarray(a) for a in t))
+
+
+def init_traffic_state(stakes, params, seed: int) -> TrafficState:
+    """Fresh traffic state: the shared active set (traffic.py hash init —
+    the identical numpy code the oracle runs) and V empty value slots."""
+    p = params.validate()
+    if not p.has_traffic:
+        raise ValueError("init_traffic_state requires traffic to be "
+                         "engaged (traffic_values > 1 or a queue cap)")
+    stakes = np.asarray(stakes, dtype=np.int64)
+    N, S, C = p.num_nodes, p.active_set_size, p.rc_slots
+    V = p.traffic_values
+    active = build_shared_active_set(stakes, seed, S, p.init_draws)
+    zi = lambda shape: jnp.zeros(shape, jnp.int32)
+    return TrafficState(
+        active=jnp.asarray(active),
+        failed=jnp.zeros((N,), bool),
+        next_vid=jnp.int32(0),
+        v_live=jnp.zeros((V,), bool),
+        v_vid=jnp.full((V,), -1, jnp.int32),
+        v_origin=jnp.full((V,), N, jnp.int32),
+        v_birth=zi((V,)),
+        v_stall=zi((V,)),
+        v_holder=jnp.zeros((V, N), bool),
+        v_hop=jnp.full((V, N), -1, jnp.int32),
+        v_m=zi((V,)),
+        pruned=jnp.zeros((V, N, S), bool),
+        rc_src=jnp.full((V, N, C), N, jnp.int32),
+        rc_score=zi((V, N, C)),
+        rc_shi=zi((V, N, C)),
+        rc_slo=zi((V, N, C)),
+        rc_upserts=zi((V, N)),
+        inj_acc=jnp.int32(0), injdrop_acc=jnp.int32(0),
+        ret_acc=jnp.int32(0), conv_acc=jnp.int32(0),
+        defer_acc=zi((N,)), qdrop_acc=zi((N,)),
+        sent_acc=zi((N,)), recv_acc=zi((N,)), prune_acc=zi((N,)),
+    )
+
+
+def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
+                       state: TrafficState, it: jax.Array,
+                       detail: bool = False, trace: bool = False,
+                       knobs: EngineKnobs | None = None):
+    """One traffic round for all V value slots.  Returns (state, rows).
+
+    The spec (phase order, rank orders, precedence) is the module
+    docstring of :mod:`gossip_sim_tpu.traffic`; ``TrafficOracle.run_round``
+    is the loop-based twin of this function and the two must stay
+    bit-identical."""
+    p, kn = _split_params(params, knobs)
+    if p.traffic_slots <= 0:
+        raise ValueError("traffic_round_step requires traffic_slots > 0")
+    it = jnp.asarray(it).astype(jnp.int32)
+    N, S, C, K, H = (p.num_nodes, p.active_set_size, p.rc_slots,
+                     p.k_inbound, p.hist_bins)
+    V = p.traffic_slots
+    F = min(p.push_fanout, S)
+    pack = _pack_base(N)
+    pb = pack.bit_length() - 1
+    NF, NS = N * F, N * S
+    iota_n = jnp.arange(N, dtype=jnp.int32)
+    iota_v = jnp.arange(V, dtype=jnp.int32)
+
+    with jax.named_scope("traffic/churn"):
+        failed = state.failed
+        if p.has_churn:
+            basis_c = round_basis_arr(kn.impair_seed, it, SALT_CHURN, jnp)
+            hu64 = node_u32_arr(basis_c, jnp.arange(N, dtype=jnp.uint32),
+                                jnp).astype(jnp.uint64)
+            fail_ev = hu64 < rate_threshold_arr(kn.churn_fail_rate, jnp)
+            rec_ev = hu64 < rate_threshold_arr(kn.churn_recover_rate, jnp)
+            failed = jnp.where(failed, ~rec_ev, fail_ev)
+
+    with jax.named_scope("traffic/inject"):
+        # ---- round-start injection: R counter-hashed stake-weighted
+        # origins into ascending free slots (traffic.py spec) -------------
+        rate = jnp.clip(kn.traffic_rate, 0, V)
+        free = ~state.v_live
+        freerank = jnp.cumsum(free.astype(jnp.int32)) - free.astype(jnp.int32)
+        n_free = jnp.sum(free, dtype=jnp.int32)
+        n_inj = jnp.minimum(rate, n_free)
+        injd = rate - n_inj
+        do_inj = free & (freerank < n_inj)
+        b_oc = round_basis_arr(kn.impair_seed, it, SALT_TRAFFIC_OCLASS, jnp)
+        b_om = round_basis_arr(kn.impair_seed, it, SALT_TRAFFIC_OMEMBER, jnp)
+        ju = freerank.astype(jnp.uint32)
+        origin_new = class_draw_arr(
+            ttables,
+            u01_arr(node_u32_arr(b_oc, ju, jnp), jnp),
+            u01_arr(node_u32_arr(b_om, ju, jnp), jnp), jnp).astype(jnp.int32)
+        onehot_o = iota_n[None, :] == origin_new[:, None]          # [V, N]
+        v_live = state.v_live | do_inj
+        v_vid = jnp.where(do_inj, state.next_vid + freerank, state.v_vid)
+        v_origin = jnp.where(do_inj, origin_new, state.v_origin)
+        v_birth = jnp.where(do_inj, it, state.v_birth)
+        v_holder = jnp.where(do_inj[:, None], onehot_o, state.v_holder)
+        v_hop = jnp.where(do_inj[:, None],
+                          jnp.where(onehot_o, 0, -1), state.v_hop)
+        v_m = jnp.where(do_inj, 0, state.v_m)
+        pruned = jnp.where(do_inj[:, None, None], False, state.pruned)
+        rc_src = jnp.where(do_inj[:, None, None], N, state.rc_src)
+        rc_score = jnp.where(do_inj[:, None, None], 0, state.rc_score)
+        rc_shi = jnp.where(do_inj[:, None, None], 0, state.rc_shi)
+        rc_slo = jnp.where(do_inj[:, None, None], 0, state.rc_slo)
+        rc_ups = jnp.where(do_inj[:, None], 0, state.rc_upserts)
+        next_vid = state.next_vid + n_inj
+        # the prune bits verb 1 consults this round (pre-prune-apply,
+        # pre-rotation) — the flight recorder's per-value snapshot
+        pruned_pre = pruned
+
+    with jax.named_scope("traffic/candidates"):
+        # ---- verb 1 with a value axis: first F valid SHARED slots -------
+        active = state.active                                       # [N, S]
+        is_peer = active < N
+        q = jnp.minimum(active, N - 1).reshape(1, NS)
+        tfail_ns = (_lookup(failed.astype(jnp.int32)[None, :], q, N,
+                            pack).reshape(N, S) == 1) & is_peer
+        sender = v_live[:, None] & v_holder & (~failed)[None, :]    # [V, N]
+        peer_b = jnp.broadcast_to(active[None], (V, N, S))
+        valid = (sender[:, :, None] & is_peer[None] & ~pruned
+                 & (peer_b != v_origin[:, None, None]))
+        skey = jnp.where(valid, jnp.arange(S, dtype=jnp.int32)[None, None, :],
+                         S)
+        tf_b = jnp.broadcast_to(tfail_ns.astype(jnp.int32)[None], (V, N, S))
+        skey_s, peer_sf, tfail_sf = lax.sort(
+            (skey, peer_b, tf_b), dimension=-1, num_keys=1)
+        slot_ok = skey_s[..., :F] < S                               # [V,N,F]
+        peerF = peer_sf[..., :F]
+        tfailF = tfail_sf[..., :F] == 1
+
+    with jax.named_scope("traffic/egress_cap"):
+        # ---- egress budget: exclusive cumsum per sender over the
+        # value-major candidate order (m asc, fanout slot asc) ------------
+        c = slot_ok.astype(jnp.int32)
+        ct = jnp.moveaxis(c, 0, 1).reshape(N, V * F)
+        erank_t = jnp.cumsum(ct, axis=1) - ct
+        erank = jnp.moveaxis(erank_t.reshape(N, V, F), 0, 1)        # [V,N,F]
+        ecap_on = kn.node_egress_cap > 0
+        sent = slot_ok & (~ecap_on | (erank < kn.node_egress_cap))
+        deferred = slot_ok & ~sent
+
+    with jax.named_scope("traffic/network"):
+        # ---- faults precedence on sent messages: failed target >
+        # partition > per-value packet loss -------------------------------
+        live_send = sent & ~tfailF
+        sup_mask = drop_mask = None
+        if p.has_partition:
+            part_on = ((kn.partition_at >= 0) & (it >= kn.partition_at)
+                       & ((kn.heal_at < 0) | (it < kn.heal_at)))
+            side_dst = tables.side[jnp.minimum(peerF, N)]
+            sup_mask = (live_send & part_on
+                        & (tables.side[:N][None, :, None] != side_dst))
+            live_send = live_send & ~sup_mask
+        if p.has_loss:
+            basis_e = round_basis_arr(kn.impair_seed, it, SALT_TRAFFIC_LOSS,
+                                      jnp)
+            vb = value_basis_arr(basis_e, v_vid, jnp)               # [V]
+            ue = edge_u32_arr(vb[:, None, None],
+                              iota_n.astype(jnp.uint32)[None, :, None],
+                              peerF.astype(jnp.uint32), jnp)
+            drop_mask = live_send & (
+                ue.astype(jnp.uint64)
+                < rate_threshold_arr(kn.packet_loss_rate, jnp))
+            live_send = live_send & ~drop_mask
+        arrived = live_send                                         # [V,N,F]
+
+    with jax.named_scope("traffic/ingress_cap"):
+        # ---- ingress budget: ONE flat (target, value-major order) sort
+        # across the whole value axis — the cross-value contention point --
+        L = V * NF
+        tgt_flat = jnp.where(arrived, peerF, N).reshape(1, L)
+        order = jnp.arange(L, dtype=jnp.int32)[None, :]
+        kd_pc = jnp.concatenate([tgt_flat, iota_n[None, :]], axis=1)
+        ord_pc = jnp.concatenate(
+            [order, jnp.full((1, N), BIG, jnp.int32)], axis=1)
+        k2, ord_s = lax.sort((kd_pc, ord_pc), dimension=-1, num_keys=2)
+        rank_a = _rank_in_run(k2)
+        is_ps = (ord_s == BIG) & (k2 < N)
+        cnt_k = jnp.where(is_ps, k2, BIG)
+        _, arr_cnt = lax.sort((cnt_k, rank_a), dimension=-1, num_keys=1)
+        arrived_node = arr_cnt[0, :N]                               # [N]
+        icap_on = kn.node_ingress_cap > 0
+        acc_flag = ((k2 < N) & ~is_ps
+                    & (~icap_on | (rank_a < kn.node_ingress_cap)))
+        _, acc_back = lax.sort((ord_s, acc_flag.astype(jnp.int32)),
+                               dimension=-1, num_keys=1)
+        accepted = (acc_back[0, :L].reshape(V, N, F) == 1) & arrived
+        qdropped = arrived & ~accepted
+        accepted_node = jnp.where(icap_on,
+                                  jnp.minimum(arrived_node,
+                                              kn.node_ingress_cap),
+                                  arrived_node)                     # [N]
+        qdrop_node = arrived_node - accepted_node
+
+    with jax.named_scope("traffic/consume"):
+        # ---- verb 2 with a value axis: rank accepted inbound per
+        # (value, target) by (clamped hop, src); deliver + first-sender ---
+        th = v_hop + 1                                              # [V, N]
+        ch = jnp.minimum(th, H - 1)
+        kv = ((ch[:, :, None] << pb) | iota_n[None, :, None])
+        kv = jnp.broadcast_to(kv, (V, N, F)).reshape(V, NF)
+        clampf = jnp.broadcast_to((th > H - 1)[:, :, None].astype(jnp.int32),
+                                  (V, N, F)).reshape(V, NF)
+        shi_e = jnp.broadcast_to(tables.shi[None, :N, None],
+                                 (V, N, F)).reshape(V, NF)
+        slo_e = jnp.broadcast_to(tables.slo[None, :N, None],
+                                 (V, N, F)).reshape(V, NF)
+        kd = jnp.where(accepted, peerF, N).reshape(V, NF)
+        pseudo_t = jnp.broadcast_to(iota_n[None, :], (V, N))
+        kd_c = jnp.concatenate([kd, pseudo_t], axis=1)              # [V,NF+N]
+        kv_c = jnp.concatenate([kv, jnp.full((V, N), BIG)], axis=1)
+        cl_c = jnp.concatenate([clampf, jnp.zeros((V, N), jnp.int32)], axis=1)
+        shi_c = jnp.concatenate([shi_e, jnp.zeros((V, N), jnp.int32)], axis=1)
+        slo_c = jnp.concatenate([slo_e, jnp.zeros((V, N), jnp.int32)], axis=1)
+        st_, skv, scl, shi_s, slo_s = lax.sort(
+            (kd_c, kv_c, cl_c, shi_c, slo_c), dimension=-1, num_keys=2)
+        rank = _rank_in_run(st_)
+        is_pseudo = (skv == BIG) & (st_ < N)
+        real = (skv != BIG) & (st_ < N)
+
+        # rank-0 (minimum (hop, src)) entry per (value, target) run
+        fd_k = jnp.where((rank == 0) & (st_ < N), st_, BIG)
+        _, fd_kv, fd_cl = lax.sort((fd_k, skv, scl), dimension=-1, num_keys=1)
+        fkv = fd_kv[:, :N]
+        has_inb = fkv != BIG                                        # [V, N]
+        first_src = jnp.where(has_inb, fkv & (pack - 1), -1)
+        first_hop = jnp.where(has_inb, fkv >> pb, -1)
+        first_clamped = jnp.where(has_inb, fd_cl[:, :N], 0)
+
+        # accepted counts per (value, target) via the pseudo rank
+        ing_k = jnp.where(is_pseudo, st_, BIG)
+        _, ing_cnt = lax.sort((ing_k, rank), dimension=-1, num_keys=1)
+        ingress_mv = ing_cnt[:, :N]                                 # [V, N]
+        inb_dropped = jnp.sum(real & (rank >= K), dtype=jnp.int32)
+
+        new_del = has_inb & ~v_holder                               # [V, N]
+        v_holder = v_holder | new_del
+        v_hop = jnp.where(new_del, first_hop, v_hop)
+        hop_clamped = jnp.sum(new_del & (first_clamped == 1),
+                              dtype=jnp.int32)
+        delivered = jnp.sum(new_del, dtype=jnp.int32)
+        accepted_total = jnp.sum(accepted, dtype=jnp.int32)
+        redundant = accepted_total - delivered
+
+        # inbound rows [V, N, K] via the slot-aligned two-sort compaction
+        NK = N * K
+        keep = real & (rank < K)
+        gk = jnp.where(keep, (st_ * K + rank) * 2, BIG)
+        slot_keys = jnp.broadcast_to(
+            jnp.arange(NK, dtype=jnp.int32)[None, :] * 2 + 1, (V, NK))
+        ga = jnp.concatenate([gk, slot_keys], axis=1)
+        kv_a = jnp.concatenate([skv, jnp.full((V, NK), BIG)], axis=1)
+        shi_a = jnp.concatenate([shi_s, jnp.zeros((V, NK), jnp.int32)],
+                                axis=1)
+        slo_a = jnp.concatenate([slo_s, jnp.zeros((V, NK), jnp.int32)],
+                                axis=1)
+        sA, kvA, hiA, loA = lax.sort((ga, kv_a, shi_a, slo_a),
+                                     dimension=-1, num_keys=1)
+        bndA = jnp.concatenate(
+            [jnp.ones((V, 1), bool), (sA >> 1)[:, 1:] != (sA >> 1)[:, :-1]],
+            axis=1)
+        gB = jnp.where(bndA, sA, BIG)
+        sB, kvB, hiB, loB = lax.sort((gB, kvA, hiA, loA),
+                                     dimension=-1, num_keys=1)
+        inb_real = (sB[:, :NK] & 1) == 0
+        inb = jnp.where(inb_real, kvB[:, :NK] & (pack - 1), N).reshape(V, N, K)
+        inb_shi = jnp.where(inb_real, hiB[:, :NK], 0).reshape(V, N, K)
+        inb_slo = jnp.where(inb_real, loB[:, :NK], 0).reshape(V, N, K)
+
+    with jax.named_scope("traffic/rc_merge"):
+        # ---- received-cache merge (verb 2 tail, O -> V) -----------------
+        kpos = jnp.arange(K, dtype=jnp.int32)[None, None, :]
+        fk = jnp.concatenate([rc_src * 2, inb * 2 + 1], axis=-1)
+        fpos = jnp.concatenate(
+            [jnp.broadcast_to(jnp.full((1, 1, C), BIG), (V, N, C)),
+             jnp.broadcast_to(kpos, (V, N, K))], axis=-1)
+        fk_s, fpos_s = lax.sort((fk, fpos), dimension=-1, num_keys=1)
+        dup_s = jnp.concatenate(
+            [jnp.zeros((V, N, 1), bool),
+             (fk_s[..., 1:] >> 1) == (fk_s[..., :-1] >> 1)], axis=-1)
+        back_k, back_d = lax.sort(
+            (fpos_s, dup_s.astype(jnp.int32)), dimension=-1, num_keys=1)
+        found = (back_d[..., :K] == 1) & (inb < N)
+
+        base_len = jnp.sum(rc_src < N, axis=-1, dtype=jnp.int32)
+        want = (inb < N) & ~found
+        ln = base_len
+        allowed_cols = []
+        for r in range(K):
+            a = want[..., r] & ((r < 2) | (ln < p.received_cap))
+            allowed_cols.append(a)
+            ln = ln + a.astype(jnp.int32)
+        allowed = jnp.stack(allowed_cols, axis=-1)
+
+        bump = found & (kpos < 2)
+        include = bump | allowed
+        contrib = (kpos < 2).astype(jnp.int32)
+        mk = jnp.concatenate(
+            [jnp.where(rc_src < N, rc_src * 2, BIG),
+             jnp.where(include, inb * 2 + 1, BIG)], axis=-1)
+        msc = jnp.concatenate(
+            [rc_score, jnp.where(include, contrib, 0)], axis=-1)
+        mhi = jnp.concatenate([rc_shi, inb_shi], axis=-1)
+        mlo = jnp.concatenate([rc_slo, inb_slo], axis=-1)
+        mk_s, msc_s, mhi_s, mlo_s = lax.sort(
+            (mk, msc, mhi, mlo), dimension=-1, num_keys=1)
+        is_dup = jnp.concatenate(
+            [jnp.zeros((V, N, 1), bool),
+             ((mk_s[..., 1:] >> 1) == (mk_s[..., :-1] >> 1))
+             & ((mk_s[..., 1:] & 1) == 1)], axis=-1)
+        nxt_dup = jnp.concatenate([is_dup[..., 1:],
+                                   jnp.zeros((V, N, 1), bool)], axis=-1)
+        nxt_sc = jnp.concatenate([msc_s[..., 1:],
+                                  jnp.zeros((V, N, 1), jnp.int32)], axis=-1)
+        msc_s = msc_s + jnp.where(nxt_dup, nxt_sc, 0)
+        valid_m = (mk_s != BIG) & ~is_dup
+        ck = jnp.where(valid_m, mk_s >> 1, BIG)
+        ck_s, csc, chi, clo = lax.sort(
+            (ck, msc_s, mhi_s, mlo_s), dimension=-1, num_keys=1)
+        n_valid = jnp.sum(valid_m, axis=-1, dtype=jnp.int32)
+        rc_overflow = jnp.sum(jnp.maximum(n_valid - C, 0), dtype=jnp.int32)
+        rc_src = jnp.where(ck_s[..., :C] != BIG, ck_s[..., :C], N)
+        rc_score = jnp.where(ck_s[..., :C] != BIG, csc[..., :C], 0)
+        rc_shi = jnp.where(ck_s[..., :C] != BIG, chi[..., :C], 0)
+        rc_slo = jnp.where(ck_s[..., :C] != BIG, clo[..., :C], 0)
+        any_inb = inb[..., 0] < N
+        rc_ups = rc_ups + any_inb.astype(jnp.int32)
+
+    with jax.named_scope("traffic/prune_decide"):
+        # ---- verb 3 with a value axis (origin = the value's origin) -----
+        fired = (rc_ups >= p.min_num_upserts) & v_live[:, None]
+        stake_dest = tables.stakes[:N][None, :]
+        stake_org = tables.stakes[jnp.minimum(v_origin, N)][:, None]
+        min_stake = jnp.minimum(stake_dest, stake_org)              # [V, N]
+        min_ingress_stake = (min_stake.astype(jnp.float64)
+                             * kn.prune_stake_threshold).astype(jnp.int64)
+        member = rc_src < N
+        mx = jnp.iinfo(jnp.int32).max
+        neg_score = jnp.where(member, -rc_score, mx)
+        neg_hi = jnp.where(member, -rc_shi, mx)
+        neg_lo = jnp.where(member, -rc_slo, mx)
+        _, _, _, src_sorted, hi_sorted, lo_sorted = lax.sort(
+            (neg_score, neg_hi, neg_lo, rc_src, rc_shi, rc_slo),
+            dimension=-1, num_keys=4)
+        memb_sorted = src_sorted < N
+        stake_sorted = ((hi_sorted.astype(jnp.int64) << 31)
+                        | lo_sorted.astype(jnp.int64))
+        cum_excl = jnp.cumsum(stake_sorted, axis=-1) - stake_sorted
+        posn = jnp.arange(C)[None, None, :]
+        pruned_slot = (memb_sorted
+                       & (posn >= kn.min_ingress_nodes)
+                       & (cum_excl >= min_ingress_stake[..., None])
+                       & (src_sorted != v_origin[:, None, None])
+                       & fired[..., None])
+        n_pruned = jnp.sum(pruned_slot, axis=-1, dtype=jnp.int32)   # [V, N]
+        m_prunes = jnp.sum(n_pruned, axis=-1, dtype=jnp.int32)      # [V]
+        accepted_mv = jnp.sum(ingress_mv, axis=-1, dtype=jnp.int32)  # [V]
+        v_m = v_m + accepted_mv + m_prunes
+
+    with jax.named_scope("traffic/prune_apply"):
+        # ---- verb 4 with a value axis on the SHARED edge keys -----------
+        NP = min(p.pa_slots, C)
+        pk_rows = jnp.where(pruned_slot, posn.astype(jnp.int32), C)
+        pk_s, psrc_s = lax.sort((pk_rows, src_sorted), dimension=-1,
+                                num_keys=1)
+        over_budget = (jnp.any(pk_s[..., NP:NP + 1] < C) if NP < C
+                       else jnp.array(False))
+        t_rows = jnp.broadcast_to(iota_n[None, :, None], (V, N, C))
+        pair_live = pk_s < C
+        edge_keys = (jnp.minimum(active, N - 1) * pack
+                     + iota_n[:, None]).reshape(NS)
+        edge_keys = jnp.where(is_peer.reshape(NS), edge_keys * 2 + 1, BIG)
+        edge_keys = jnp.broadcast_to(edge_keys[None, :], (V, NS))
+        edge_pos = jnp.broadcast_to(
+            jnp.arange(NS, dtype=jnp.int32)[None, :], (V, NS))
+
+        def _apply(np_slots):
+            pair_keys = jnp.where(
+                pair_live[..., :np_slots],
+                (t_rows[..., :np_slots] * pack + psrc_s[..., :np_slots]) * 2,
+                BIG).reshape(V, N * np_slots)
+            k = jnp.concatenate([edge_keys, pair_keys], axis=1)
+            ppos = jnp.concatenate(
+                [edge_pos, jnp.full((V, N * np_slots), BIG)], axis=1)
+            ks, pos_s = lax.sort((k, ppos), dimension=-1, num_keys=1)
+            hit_s = jnp.concatenate(
+                [jnp.zeros((V, 1), bool),
+                 ((ks[:, 1:] >> 1) == (ks[:, :-1] >> 1))
+                 & ((ks[:, 1:] & 1) == 1)], axis=1)
+            _, hit_back = lax.sort((pos_s, hit_s.astype(jnp.int32)),
+                                   dimension=-1, num_keys=1)
+            return hit_back[:, :NS].reshape(V, N, S) == 1
+
+        if NP < C:
+            hit = lax.cond(over_budget, lambda: _apply(C),
+                           lambda: _apply(NP))
+        else:
+            hit = _apply(C)
+        pruned = pruned | (hit & is_peer[None])
+        rc_src = jnp.where(fired[..., None], N, rc_src)
+        rc_score = jnp.where(fired[..., None], 0, rc_score)
+        rc_shi = jnp.where(fired[..., None], 0, rc_shi)
+        rc_slo = jnp.where(fired[..., None], 0, rc_slo)
+        rc_ups = jnp.where(fired, 0, rc_ups)
+
+    with jax.named_scope("traffic/rotate"):
+        # ---- verb 5, shared: ONE hash-driven rotation schedule ----------
+        T = p.rot_tries
+        b_rot = round_basis_arr(kn.impair_seed, it, SALT_TRAFFIC_ROT, jnp)
+        b_rc = round_basis_arr(kn.impair_seed, it, SALT_TRAFFIC_RCLASS, jnp)
+        b_rm = round_basis_arr(kn.impair_seed, it, SALT_TRAFFIC_RMEMBER, jnp)
+        u_rot = u01_arr(node_u32_arr(b_rot, jnp.arange(N, dtype=jnp.uint32),
+                                     jnp), jnp)
+        rotate = u_rot < kn.probability_of_rotation
+        nodes_u = jnp.arange(N, dtype=jnp.uint32)[:, None]
+        tries_u = jnp.arange(T, dtype=jnp.uint32)[None, :]
+        cands = class_draw_arr(
+            ttables,
+            u01_arr(edge_u32_arr(b_rc, nodes_u, tries_u, jnp), jnp),
+            u01_arr(edge_u32_arr(b_rm, nodes_u, tries_u, jnp), jnp),
+            jnp).astype(jnp.int32)                                  # [N, T]
+        chosen = jnp.full((N,), N, jnp.int32)
+        found_new = jnp.zeros((N,), bool)
+        for t in range(T):
+            cand = cands[:, t]
+            ok = ((cand != iota_n)
+                  & ~jnp.any(active == cand[:, None], axis=-1))
+            take = ok & ~found_new
+            chosen = jnp.where(take, cand, chosen)
+            found_new = found_new | ok
+        do_rot = rotate & found_new
+        cnt = jnp.sum(is_peer, axis=-1, dtype=jnp.int32)
+        full_row = cnt >= S
+        shift_act = jnp.concatenate([active[:, 1:], chosen[:, None]], axis=-1)
+        slot_oh = (jnp.arange(S)[None, :]
+                   == jnp.minimum(cnt, S - 1)[:, None])
+        append_act = jnp.where(slot_oh & ~full_row[:, None],
+                               chosen[:, None], active)
+        new_active = jnp.where(do_rot[:, None],
+                               jnp.where(full_row[:, None], shift_act,
+                                         append_act),
+                               active)
+        shift_prn = jnp.concatenate(
+            [pruned[:, :, 1:], jnp.zeros((V, N, 1), bool)], axis=-1)
+        pruned = jnp.where((do_rot & full_row)[None, :, None],
+                           shift_prn, pruned)
+
+    with jax.named_scope("traffic/retire"):
+        # ---- stall tracking, retirement, slot recycle -------------------
+        progress = jnp.sum(new_del, axis=-1, dtype=jnp.int32) > 0   # [V]
+        v_stall = jnp.where(~v_live, 0,
+                            jnp.where(do_inj | progress, 0,
+                                      state.v_stall + 1))
+        holders = jnp.sum(v_holder, axis=-1, dtype=jnp.int32)       # [V]
+        full_v = holders == N
+        retire = v_live & (full_v | (v_stall >= kn.traffic_stall_rounds))
+        v_live_post = v_live & ~retire
+        hops_sum = jnp.sum(jnp.where(v_holder, v_hop, 0), axis=-1,
+                           dtype=jnp.int32)
+
+    with jax.named_scope("traffic/round_stats"):
+        g = (it >= kn.warm_up_rounds).astype(jnp.int32)
+        node_deferred = jnp.sum(deferred, axis=(0, 2),
+                                dtype=jnp.int32)                    # [N] src
+        sent_node = jnp.sum(sent, axis=(0, 2), dtype=jnp.int32)
+        n_retired = jnp.sum(retire, dtype=jnp.int32)
+        n_conv = jnp.sum(retire & full_v, dtype=jnp.int32)
+        zero_s = jnp.int32(0)
+        new_state = TrafficState(
+            active=new_active, failed=failed, next_vid=next_vid,
+            v_live=v_live_post, v_vid=v_vid, v_origin=v_origin,
+            v_birth=v_birth, v_stall=v_stall, v_holder=v_holder,
+            v_hop=v_hop, v_m=v_m, pruned=pruned,
+            rc_src=rc_src, rc_score=rc_score, rc_shi=rc_shi, rc_slo=rc_slo,
+            rc_upserts=rc_ups,
+            inj_acc=state.inj_acc + g * n_inj,
+            injdrop_acc=state.injdrop_acc + g * injd,
+            ret_acc=state.ret_acc + g * n_retired,
+            conv_acc=state.conv_acc + g * n_conv,
+            defer_acc=state.defer_acc + g * node_deferred,
+            qdrop_acc=state.qdrop_acc + g * qdrop_node.astype(jnp.int32),
+            sent_acc=state.sent_acc + g * sent_node,
+            recv_acc=state.recv_acc + g * accepted_node.astype(jnp.int32),
+            prune_acc=state.prune_acc
+            + g * jnp.sum(n_pruned, axis=0, dtype=jnp.int32),
+        )
+        rows = {
+            "injected": n_inj,
+            "inject_dropped": injd,
+            "live": jnp.sum(v_live_post, dtype=jnp.int32),
+            "sends": jnp.sum(sent, dtype=jnp.int32),
+            "deferred": jnp.sum(deferred, dtype=jnp.int32),
+            "failed_target": jnp.sum(sent & tfailF, dtype=jnp.int32),
+            "suppressed": (jnp.sum(sup_mask, dtype=jnp.int32)
+                           if sup_mask is not None else zero_s),
+            "dropped": (jnp.sum(drop_mask, dtype=jnp.int32)
+                        if drop_mask is not None else zero_s),
+            "arrived": jnp.sum(arrived, dtype=jnp.int32),
+            "queue_dropped": jnp.sum(qdropped, dtype=jnp.int32),
+            "accepted": accepted_total,
+            "delivered": delivered,
+            "redundant": redundant,
+            "prunes_sent": jnp.sum(m_prunes, dtype=jnp.int32),
+            "retired": n_retired,
+            "converged": n_conv,
+            "hop_clamped": hop_clamped,
+            "qdepth_max": jnp.max(node_deferred),
+            "inflow_max": jnp.max(accepted_node).astype(jnp.int32),
+            "inb_dropped": inb_dropped,
+            "rc_overflow": rc_overflow,
+            # per-value retirement records (valid where ret_mask)
+            "ret_mask": retire,
+            "ret_vid": v_vid,
+            "ret_origin": v_origin,
+            "ret_birth": v_birth,
+            "ret_holders": holders,
+            "ret_m": v_m,
+            "ret_full": full_v,
+            "ret_hops_sum": hops_sum,
+        }
+        if detail or trace:
+            rows["live_mask"] = v_live_post
+            rows["t_holder"] = v_holder
+            rows["t_hop"] = jnp.where(v_holder, v_hop, -1)
+            rows["node_deferred"] = node_deferred
+            rows["node_queue_dropped"] = qdrop_node.astype(jnp.int32)
+            rows["node_sent"] = sent_node
+            rows["node_recv"] = accepted_node.astype(jnp.int32)
+        if trace:
+            # flight recorder v3 (obs/trace.py): value-slot event rows.
+            # codes: accepted(1) / failed_target(2) / suppressed(3) /
+            # dropped(4) / deferred(5) / queue_dropped(6), the faults
+            # precedence extended by the queue caps (traffic.py).
+            code = jnp.zeros((V, N, F), jnp.int32)
+            code = jnp.where(slot_ok, TRAFFIC_DEFERRED, code)
+            code = jnp.where(sent & tfailF, TRAFFIC_FAILED_TARGET, code)
+            if sup_mask is not None:
+                code = jnp.where(sup_mask, TRAFFIC_SUPPRESSED, code)
+            if drop_mask is not None:
+                code = jnp.where(drop_mask, TRAFFIC_DROPPED, code)
+            code = jnp.where(qdropped, TRAFFIC_QUEUE_DROPPED, code)
+            code = jnp.where(accepted, TRAFFIC_ACCEPTED, code)
+            rows["trace_peers"] = jnp.where(slot_ok, peerF, -1)
+            rows["trace_code"] = code
+            rows["trace_first"] = first_src
+            rows["trace_vid"] = jnp.where(v_live, v_vid, -1)
+            rows["trace_origin"] = jnp.where(v_live, v_origin, -1)
+            rows["trace_active"] = jnp.where(is_peer, active, -1)
+            rows["trace_pruned"] = pruned_pre
+            rows["trace_failed"] = failed
+            rows["trace_prunes"] = m_prunes
+            PC = p.traffic_prune_cap
+
+            def _prune_pairs():
+                live_flat = pruned_slot.reshape(V, N * C)
+                pk_flat = jnp.where(
+                    live_flat,
+                    jnp.arange(N * C, dtype=jnp.int32)[None, :], BIG)
+                pruner_flat = jnp.broadcast_to(
+                    iota_n[None, :, None], (V, N, C)).reshape(V, N * C)
+                prunee_flat = src_sorted.reshape(V, N * C)
+                pks, tps, tpd = lax.sort(
+                    (pk_flat, pruner_flat, prunee_flat),
+                    dimension=-1, num_keys=1)
+                pair_ok = pks[:, :PC] != BIG
+                return (jnp.where(pair_ok, tps[:, :PC], -1),
+                        jnp.where(pair_ok, tpd[:, :PC], -1))
+
+            rows["trace_prune_src"], rows["trace_prune_dst"] = lax.cond(
+                jnp.sum(m_prunes) > 0, _prune_pairs,
+                lambda: (jnp.full((V, PC), -1, jnp.int32),
+                         jnp.full((V, PC), -1, jnp.int32)))
+    return new_state, rows
+
+
+# --------------------------------------------------------------------------
+# multi-round runners (serial scan + lane-batched scan)
+# --------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnums=(0, 5, 6, 7), donate_argnums=(3,))
+def _run_traffic(static, tables, ttables, state, knobs, num_iters, detail,
+                 trace, start_it):
+    def step(st, it):
+        return traffic_round_step(static, tables, ttables, st, it,
+                                  detail=detail, trace=trace, knobs=knobs)
+    its = jnp.arange(num_iters) + start_it
+    return lax.scan(step, state, its)
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6), donate_argnums=(3,))
+def _run_traffic_lanes(static, tables, ttables, lane_state, lane_knobs,
+                       num_iters, detail, start_it):
+    def step(st, it):
+        return jax.vmap(
+            lambda s, k: traffic_round_step(static, tables, ttables, s, it,
+                                            detail=detail, knobs=k)
+        )(st, lane_knobs)
+    its = jnp.arange(num_iters) + start_it
+    return lax.scan(step, lane_state, its)
+
+
+def traffic_compiled_cache_size() -> int:
+    try:
+        return int(_run_traffic._cache_size()
+                   + _run_traffic_lanes._cache_size())
+    except Exception:  # pragma: no cover - jax internals moved
+        return -1
+
+
+def clear_traffic_compile_cache() -> None:
+    try:
+        _run_traffic.clear_cache()
+        _run_traffic_lanes.clear_cache()
+    except Exception:  # pragma: no cover
+        pass
+
+
+def run_traffic_rounds(params, tables: ClusterTables,
+                       ttables: TrafficTables, state: TrafficState,
+                       num_iters: int, start_it=0, detail: bool = False,
+                       trace: bool = False,
+                       knobs: EngineKnobs | None = None):
+    """Run ``num_iters`` traffic rounds under one jitted scan.  Same
+    compile-once contract as :func:`engine.core.run_rounds`: only the
+    :class:`EngineStatic` key is hashed, every traffic knob is traced, and
+    each call records ``engine/compiles`` or ``engine/cache_hits``."""
+    static, kn = _split_params(params, knobs)
+    before = traffic_compiled_cache_size()
+    out = _run_traffic(static, tables, ttables, state, kn, int(num_iters),
+                       bool(detail), bool(trace),
+                       jnp.asarray(start_it, jnp.int32))
+    _note_compile_accounting(before, traffic_compiled_cache_size())
+    return out
+
+
+def broadcast_traffic_state(state: TrafficState, lanes: int) -> TrafficState:
+    """Tile one TrafficState across ``lanes`` identical lanes (the
+    engine/lanes.py ``broadcast_state`` contract: tiling is bit-exact
+    because init consumes only static geometry + the seed)."""
+    return TrafficState(
+        *(jnp.broadcast_to(jnp.asarray(x)[None],
+                           (lanes,) + tuple(jnp.shape(x)))
+          for x in state))
+
+
+def traffic_lane_state(states: TrafficState, lane: int) -> TrafficState:
+    """One lane's TrafficState view out of a ``[K, ...]`` batch."""
+    return TrafficState(*(x[lane] for x in states))
+
+
+def run_traffic_lanes(static, tables: ClusterTables, ttables: TrafficTables,
+                      lane_state: TrafficState, lane_knobs: EngineKnobs,
+                      num_iters: int, start_it=0, detail: bool = False):
+    """Lane-batched traffic sweep: K stacked knob vectors run as ONE
+    batched device program (engine/lanes.py contract: each lane is
+    bit-identical to a serial :func:`run_traffic_rounds` call).  Trace
+    rows are not offered in lane mode (same restriction as lanes.py)."""
+    before = traffic_compiled_cache_size()
+    out = _run_traffic_lanes(static, tables, ttables, lane_state, lane_knobs,
+                             int(num_iters), bool(detail),
+                             jnp.asarray(start_it, jnp.int32))
+    _note_compile_accounting(before, traffic_compiled_cache_size())
+    return out

@@ -65,11 +65,16 @@ log = logging.getLogger("gossip_sim_tpu.obs")
 
 # v2 (pull-gossip subsystem): adds the pull request/response event arrays
 # (``pull_peers``/``pull_code``/``pull_hop``) plus the ``gossip_mode`` /
-# ``pull_slots`` manifest keys.  New traces are written as v2 (pull arrays
-# present only when the mode has a pull phase); v1 traces remain readable.
+# ``pull_slots`` manifest keys.  v3 (concurrent traffic, traffic.py): adds
+# the value-id column — traffic-mode traces carry per-value-slot event
+# arrays (``value_id``/``value_origin`` identify each slot's in-flight
+# value per round; delivery and prune arrays gain a leading V axis) and
+# the ``traffic_slots`` manifest key.  New traces are written as v3
+# (traffic arrays present only in traffic mode); v1/v2 remain readable.
 TRACE_SCHEMA_V1 = "gossip-sim-tpu/trace/v1"
-TRACE_SCHEMA = "gossip-sim-tpu/trace/v2"
-READABLE_SCHEMAS = (TRACE_SCHEMA_V1, TRACE_SCHEMA)
+TRACE_SCHEMA_V2 = "gossip-sim-tpu/trace/v2"
+TRACE_SCHEMA = "gossip-sim-tpu/trace/v3"
+READABLE_SCHEMAS = (TRACE_SCHEMA_V1, TRACE_SCHEMA_V2, TRACE_SCHEMA)
 MANIFEST_NAME = "manifest.json"
 
 # per-slot outcome codes (shared with engine/core.py round_step and the
@@ -112,13 +117,38 @@ PULL_ARRAY_SPECS = {
     "pull_hop": ("int16", ("N",)),
 }
 
-#: every array name any readable schema can carry
+#: v3 concurrent-traffic arrays (traffic.py), used INSTEAD of the base
+#: specs when the manifest's ``traffic_slots`` > 0.  Dims: V = value
+#: slots.  ``value_id``/``value_origin`` are the value-id column: the
+#: per-round identity of each slot's in-flight value (-1 = free slot), so
+#: every delivery/prune event row is value-attributable.
+TRAFFIC_ARRAY_SPECS = {
+    "peers": ("int16", ("V", "N", "F")),
+    "code": ("int8", ("V", "N", "F")),
+    "dist": ("int16", ("V", "N")),
+    "first_src": ("int16", ("V", "N")),
+    "failed": ("bool", ("N",)),
+    "active": ("int16", ("N", "S")),
+    "pruned": ("bool", ("V", "N", "S")),
+    "prune_src": ("int16", ("V", "P")),
+    "prune_dst": ("int16", ("V", "P")),
+    "value_id": ("int32", ("V",)),
+    "value_origin": ("int16", ("V",)),
+    "prunes_total": ("int32", ("V",)),
+}
+
+#: every array name a non-traffic readable schema can carry
 ALL_ARRAY_SPECS = {**ARRAY_SPECS, **PULL_ARRAY_SPECS}
 
 
 def specs_for_manifest(manifest: dict) -> dict:
     """The array-spec dict a manifest's schema/mode implies (v1 manifests
-    and v2 push-mode manifests carry the base arrays only)."""
+    and v2 push-mode manifests carry the base arrays only; v3 traffic
+    manifests — ``traffic_slots`` > 0 — the traffic arrays)."""
+    if int(manifest.get("traffic_slots") or 0) > 0:
+        return {name: TRAFFIC_ARRAY_SPECS[name]
+                for name in (manifest.get("arrays") or TRAFFIC_ARRAY_SPECS)
+                if name in TRAFFIC_ARRAY_SPECS}
     return {name: ALL_ARRAY_SPECS[name]
             for name in (manifest.get("arrays") or ARRAY_SPECS)
             if name in ALL_ARRAY_SPECS}
@@ -147,9 +177,25 @@ _ENGINE_PULL_ROW_MAP = {
     "pull_hop": "pull_hop",
 }
 
+#: traffic-engine trace rows (engine/traffic.py) -> v3 traffic arrays
+_TRAFFIC_ENGINE_ROW_MAP = {
+    "trace_peers": "peers",
+    "trace_code": "code",
+    "t_hop": "dist",
+    "trace_first": "first_src",
+    "trace_failed": "failed",
+    "trace_active": "active",
+    "trace_pruned": "pruned",
+    "trace_prune_src": "prune_src",
+    "trace_prune_dst": "prune_dst",
+    "trace_vid": "value_id",
+    "trace_origin": "value_origin",
+    "trace_prunes": "prunes_total",
+}
+
 _MATCH_KEYS = ("schema", "backend", "num_nodes", "push_fanout",
                "active_set_size", "prune_cap", "seed", "origins",
-               "gossip_mode", "pull_slots")
+               "gossip_mode", "pull_slots", "traffic_slots")
 
 
 def block_from_engine_rows(rows) -> dict:
@@ -161,6 +207,13 @@ def block_from_engine_rows(rows) -> dict:
         if eng in rows:
             block[seg] = np.asarray(rows[eng])
     return block
+
+
+def traffic_block_from_engine_rows(rows) -> dict:
+    """Traffic-engine harvest rows (numpy, ``[R, V, ...]``) -> writer
+    block dict for a ``traffic_slots > 0`` (v3) trace."""
+    return {seg: np.asarray(rows[eng])
+            for eng, seg in _TRAFFIC_ENGINE_ROW_MAP.items()}
 
 
 def _atomic_write_bytes(path: str, payload: bytes) -> None:
@@ -216,16 +269,22 @@ class TraceWriter:
                  push_fanout: int, active_set_size: int, prune_cap: int,
                  origins, origin_pubkeys, seed: int, warm_up_rounds: int,
                  iterations: int, config=None, gossip_mode: str = "push",
-                 pull_slots: int = 0):
+                 pull_slots: int = 0, traffic_slots: int = 0):
         if num_nodes > self.MAX_TRACE_NODES:
             raise ValueError(
                 f"trace arrays store node ids as int16; num_nodes must be "
                 f"<= {self.MAX_TRACE_NODES}, got {num_nodes}")
         self.trace_dir = trace_dir
         os.makedirs(trace_dir, exist_ok=True)
-        self.array_specs = dict(ARRAY_SPECS)
-        if gossip_mode != "push":
-            self.array_specs.update(PULL_ARRAY_SPECS)
+        if traffic_slots > 0:
+            # v3 traffic mode: value-slot event arrays; there is no origin
+            # column (values carry their own origins per round)
+            self.array_specs = dict(TRAFFIC_ARRAY_SPECS)
+        else:
+            self.array_specs = dict(ARRAY_SPECS)
+            if gossip_mode != "push":
+                self.array_specs.update(PULL_ARRAY_SPECS)
+        from ..traffic import TRAFFIC_CODE_NAMES
         self.manifest = {
             "schema": TRACE_SCHEMA,
             "run_report_schema": RUN_REPORT_SCHEMA,
@@ -236,12 +295,15 @@ class TraceWriter:
             "prune_cap": int(prune_cap),
             "gossip_mode": str(gossip_mode),
             "pull_slots": int(pull_slots) if gossip_mode != "push" else 0,
+            "traffic_slots": int(traffic_slots),
             "origins": [int(o) for o in origins],
             "origin_pubkeys": [str(p) for p in origin_pubkeys],
             "seed": int(seed),
             "warm_up_rounds": int(warm_up_rounds),
             "iterations": int(iterations),
-            "codes": {str(k): v for k, v in TRACE_CODE_NAMES.items()},
+            "codes": ({str(k): v for k, v in TRAFFIC_CODE_NAMES.items()}
+                      if traffic_slots > 0 else
+                      {str(k): v for k, v in TRACE_CODE_NAMES.items()}),
             "pull_codes": {str(k): v for k, v in PULL_CODE_NAMES.items()},
             "arrays": {name: {"dtype": dt, "dims": list(dims)}
                        for name, (dt, dims) in self.array_specs.items()},
@@ -608,21 +670,28 @@ def validate_trace_manifest(manifest: dict) -> list:
                        ("config", dict)):
         if not isinstance(manifest.get(key), types):
             problems.append(f"key {key}: missing or not {types.__name__}")
-    for name in ARRAY_SPECS:
+    is_traffic = int(manifest.get("traffic_slots") or 0) > 0
+    base_specs = TRAFFIC_ARRAY_SPECS if is_traffic else ARRAY_SPECS
+    for name in base_specs:
         if name not in (manifest.get("arrays") or {}):
             problems.append(f"arrays entry missing: {name}")
-    if manifest.get("schema") == TRACE_SCHEMA:
-        # v2: mode + pull geometry are mandatory; pull arrays exist exactly
-        # when the mode has a pull phase
+    if manifest.get("schema") in (TRACE_SCHEMA_V2, TRACE_SCHEMA):
+        # v2+: mode + pull geometry are mandatory; pull arrays exist
+        # exactly when the mode has a pull phase
         mode = manifest.get("gossip_mode")
         if mode not in ("push", "pull", "push-pull"):
             problems.append(f"v2 manifest: bad gossip_mode {mode!r}")
         if not isinstance(manifest.get("pull_slots"), int):
             problems.append("v2 manifest: pull_slots missing or not int")
-        if mode in ("pull", "push-pull"):
+        if mode in ("pull", "push-pull") and not is_traffic:
             for name in PULL_ARRAY_SPECS:
                 if name not in (manifest.get("arrays") or {}):
                     problems.append(f"pull arrays entry missing: {name}")
+    if manifest.get("schema") == TRACE_SCHEMA and is_traffic:
+        # v3 traffic manifests: the value-id column is mandatory
+        for name in ("value_id", "value_origin"):
+            if name not in (manifest.get("arrays") or {}):
+                problems.append(f"traffic arrays entry missing: {name}")
     for seg in manifest.get("segments") or []:
         if (not isinstance(seg, dict) or "file" not in seg
                 or "start_round" not in seg or "end_round" not in seg):
@@ -657,7 +726,8 @@ def validate_trace_dir(trace_dir: str) -> list:
                    manifest["active_set_size"], manifest["prune_cap"])
     o = len(manifest["origins"])
     dim = {"N": n, "F": f_, "S": s, "P": p,
-           "Q": manifest.get("pull_slots", 0)}
+           "Q": manifest.get("pull_slots", 0),
+           "V": manifest.get("traffic_slots", 0)}
     specs = specs_for_manifest(manifest)
     for seg in manifest["segments"]:
         fpath = os.path.join(trace_dir, seg["file"])
@@ -671,7 +741,10 @@ def validate_trace_dir(trace_dir: str) -> list:
                 if name not in names:
                     problems.append(f"{seg['file']}: missing array {name}")
                     continue
-                want = (r, o) + tuple(dim[d] for d in dims)
+                # traffic (v3) arrays carry their own V axis in ``dims``
+                # instead of the per-origin column
+                want = ((r,) if dim["V"] > 0
+                        else (r, o)) + tuple(dim[d] for d in dims)
                 if z[name].shape != want:
                     problems.append(
                         f"{seg['file']}: {name} shape {z[name].shape} != "

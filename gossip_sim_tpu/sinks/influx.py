@@ -328,6 +328,33 @@ class InfluxDataPoint:
             f"prunes={prunes},bytes_written={bytes_written} ")
         self.append_timestamp()
 
+    def create_sim_traffic_point(self, it, values: dict):
+        """Concurrent-traffic series (traffic.py): one point per measured
+        round with the whole contention picture — injections, live values,
+        wire/deferred/dropped message counts across the value axis, queue
+        depths, retirements.  ``values`` carries the stats.traffic
+        ROUND_FIELDS ints (deterministic — the wire line joins the
+        parity-snapshot surface the smoke gates diff)."""
+        fields = ",".join(f"{k}={int(v)}" for k, v in sorted(values.items()))
+        self.datapoint += (
+            f"sim_traffic,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"iteration={int(it)},{fields} ")
+        self.append_timestamp()
+
+    def create_sim_traffic_summary_point(self, summary: dict):
+        """End-of-run traffic aggregate (stats/traffic.py summary()):
+        per-value latency/coverage/RMR aggregates + queue totals."""
+        parts = []
+        for k, v in sorted(summary.items()):
+            parts.append(f"{k}={float(v)}" if isinstance(v, float)
+                         else f"{k}={int(v)}")
+        self.datapoint += (
+            f"sim_traffic_summary,simulation_iter="
+            f"{self.simulation_iteration},"
+            f"start_time={self.start_timestamp} " + ",".join(parts) + " ")
+        self.append_timestamp()
+
     def create_messages_point(self, messages_direction: str, messages,
                               simulation_iter_val: int):
         for bucket, count in messages.items():

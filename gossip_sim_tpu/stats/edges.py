@@ -38,6 +38,13 @@ CAUSE_INCONSISTENT = "inconsistent_delivered"
 # not stranded in the stats layer, but the push-path failure analysis is
 # still reported so "pull papered over a push hole" stays visible
 CAUSE_RESCUED_BY_PULL = "rescued_by_pull"
+# concurrent-traffic queue-cap outcomes (traffic.py, trace schema v3):
+# the slot's candidate message was never sent (sender's egress budget
+# exhausted — deferred to a later round) or arrived but was dropped by
+# the receiver's ingress budget.  Per-value traffic arrays slice straight
+# into explain_stranded (active shared, pruned/peers/code/dist per value).
+CAUSE_EGRESS_DEFERRED = "egress_deferred"
+CAUSE_QUEUE_DROPPED = "queue_dropped"
 
 
 def delivered_mask(code: np.ndarray, dist: np.ndarray) -> np.ndarray:
@@ -153,11 +160,16 @@ def explain_stranded(active: np.ndarray, pruned: np.ndarray,
                 if k.size == 0:
                     cause = CAUSE_FANOUT_TRUNCATED
                 else:
+                    from ..traffic import (TRAFFIC_DEFERRED,
+                                           TRAFFIC_QUEUE_DROPPED)
                     c = int(code[s, k[0]])
                     cause = {
                         TRACE_SUPPRESSED: CAUSE_SUPPRESSED,
                         TRACE_DROPPED: CAUSE_DROPPED,
                         TRACE_FAILED_TARGET: CAUSE_TARGET_FAILED,
+                        # traffic (v3) queue-cap outcomes
+                        TRAFFIC_DEFERRED: CAUSE_EGRESS_DEFERRED,
+                        TRAFFIC_QUEUE_DROPPED: CAUSE_QUEUE_DROPPED,
                     }.get(c, CAUSE_INCONSISTENT)
             causes.append({"sender": int(s), "slot": int(slot),
                            "cause": cause})

@@ -1,0 +1,162 @@
+"""Concurrent-traffic statistics (traffic.py subsystem).
+
+The single-value stats suite (gossip_stats.py) is built around one origin
+per simulation; a traffic run instead produces **per-round contention
+series** (queue depths, deferrals, drops across the whole value axis) and
+**per-value retirement records** (coverage, latency, RMR per injected
+value).  ``TrafficStats`` collects both, mirrors ``GossipStats``'s
+deterministic ``parity_snapshot()`` contract (tools/traffic_smoke.py and
+the engine-vs-oracle CLI parity tests diff it), and serializes through
+``state_dict``/``load_state_dict`` for checkpoint-v6 resume.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+#: the per-round series every backend feeds (engine rows / TrafficRound
+#: fields share these names; keep in sync with tests/test_traffic.py)
+ROUND_FIELDS = [
+    "injected", "inject_dropped", "live", "sends", "deferred",
+    "failed_target", "suppressed", "dropped", "arrived", "queue_dropped",
+    "accepted", "delivered", "redundant", "prunes_sent", "retired",
+    "converged", "hop_clamped", "qdepth_max", "inflow_max",
+]
+
+#: per-value retirement record keys (traffic.retire_record)
+RECORD_FIELDS = ["vid", "origin", "birth", "retired_at", "latency_rounds",
+                 "holders", "coverage", "m", "rmr", "converged", "mean_hop"]
+
+
+class TrafficStats:
+    """Per-round series + per-value records of one traffic simulation."""
+
+    def __init__(self):
+        self.rounds = {k: [] for k in ROUND_FIELDS}
+        self.iterations = []
+        self.records = []          # retirement record dicts, vid order
+        self.final = {}            # end-of-run accumulator summary
+
+    # -- feeds ------------------------------------------------------------
+
+    def feed_round(self, it: int, values: dict) -> None:
+        self.iterations.append(int(it))
+        for k in ROUND_FIELDS:
+            self.rounds[k].append(int(values[k]))
+
+    def feed_records(self, records) -> None:
+        self.records.extend(records)
+
+    def feed_final(self, final: dict) -> None:
+        """End-of-run totals read off the engine/oracle state: the
+        measured-round accumulators plus the live (unfinished) value
+        count."""
+        self.final = {k: (int(v) if np.isscalar(v) or isinstance(v, int)
+                          else [int(x) for x in v])
+                      for k, v in final.items()}
+
+    def is_empty(self) -> bool:
+        return not self.iterations
+
+    # -- parity / persistence --------------------------------------------
+
+    def parity_snapshot(self) -> dict:
+        """Every deterministic series/record as one dict — the traffic
+        twin of GossipStats.parity_snapshot (one definition of the
+        bit-exactness surface; tools/traffic_smoke.py diffs it)."""
+        return {
+            "iterations": list(self.iterations),
+            "rounds": {k: list(v) for k, v in self.rounds.items()},
+            "records": [
+                {f: rec[f] for f in RECORD_FIELDS} for rec in self.records],
+            "final": dict(self.final),
+        }
+
+    def state_dict(self) -> dict:
+        return self.parity_snapshot()
+
+    def load_state_dict(self, d: dict) -> None:
+        self.iterations = [int(x) for x in d.get("iterations", [])]
+        self.rounds = {k: [int(x) for x in d.get("rounds", {}).get(k, [])]
+                       for k in ROUND_FIELDS}
+        self.records = [dict(r) for r in d.get("records", [])]
+        self.final = dict(d.get("final", {}))
+
+    def to_json(self) -> str:
+        return json.dumps(self.parity_snapshot(), sort_keys=True)
+
+    # -- aggregation ------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Flat aggregate dict for the run report, the end-of-run Influx
+        point, and the CLI summary line."""
+        recs = self.records
+        lat = np.asarray([r["latency_rounds"] for r in recs], np.float64)
+        cov = np.asarray([r["coverage"] for r in recs], np.float64)
+        rmr = np.asarray([r["rmr"] for r in recs], np.float64)
+        tot = {k: int(np.sum(self.rounds[k], dtype=np.int64))
+               for k in ("injected", "inject_dropped", "sends", "deferred",
+                         "queue_dropped", "dropped", "suppressed",
+                         "delivered", "redundant", "accepted",
+                         "prunes_sent", "retired", "converged",
+                         "hop_clamped")}
+        out = {
+            "measured_rounds": len(self.iterations),
+            "values_injected": tot["injected"],
+            "values_retired": tot["retired"],
+            "values_converged": tot["converged"],
+            "values_stranded": tot["retired"] - tot["converged"],
+            "values_unfinished": int(self.final.get("live_at_end", 0)),
+            "inject_dropped": tot["inject_dropped"],
+            "sends": tot["sends"],
+            "delivered": tot["delivered"],
+            "redundant": tot["redundant"],
+            "loss_dropped": tot["dropped"],
+            "suppressed": tot["suppressed"],
+            "queue_deferred": tot["deferred"],
+            "queue_dropped": tot["queue_dropped"],
+            "prunes_sent": tot["prunes_sent"],
+            "hop_clamped": tot["hop_clamped"],
+            "qdepth_max": int(max(self.rounds["qdepth_max"], default=0)),
+            "inflow_max": int(max(self.rounds["inflow_max"], default=0)),
+            "live_max": int(max(self.rounds["live"], default=0)),
+        }
+        if len(recs):
+            out.update({
+                "value_latency_mean": float(lat.mean()),
+                "value_latency_p50": float(np.percentile(lat, 50)),
+                "value_latency_p90": float(np.percentile(lat, 90)),
+                "value_latency_max": int(lat.max()),
+                "value_coverage_mean": float(cov.mean()),
+                "value_coverage_min": float(cov.min()),
+                "value_rmr_mean": float(rmr.mean()),
+            })
+        else:
+            out.update({
+                "value_latency_mean": 0.0, "value_latency_p50": 0.0,
+                "value_latency_p90": 0.0, "value_latency_max": 0,
+                "value_coverage_mean": 0.0, "value_coverage_min": 0.0,
+                "value_rmr_mean": 0.0,
+            })
+        return out
+
+
+class TrafficStatsCollection:
+    """Sweep-ordered TrafficStats (one per sweep point)."""
+
+    def __init__(self):
+        self.collection = []
+        self.points = []      # the swept knob value per point
+
+    def push(self, point_value, stats: TrafficStats) -> None:
+        self.points.append(point_value)
+        self.collection.append(stats)
+
+    def is_empty(self) -> bool:
+        return not self.collection
+
+    def summaries(self) -> list:
+        return [dict(point=p, **s.summary())
+                for p, s in zip(self.points, self.collection)]

@@ -1,0 +1,596 @@
+"""Concurrent CRDS traffic: M-value message streams, shared by both backends.
+
+Every number the simulator produced before this module describes ONE origin
+value diffusing through an otherwise idle network.  Production Solana push
+gossip carries thousands of concurrent CRDS values contending for the same
+active sets, prune state, and per-node ingress budgets (ROADMAP item 4).
+This module defines the traffic model both backends implement bit-exactly:
+
+* **One shared network.**  All in-flight values push through ONE [N, S]
+  active set with ONE rotation schedule.  Prune bits and received-cache
+  scoring stay keyed per value (Solana prunes per *origin*; counter-hashed
+  injection origins are almost always distinct, so value == origin key is
+  the documented simplification), but they live on the *shared* slots: a
+  rotation evicts the pruned bits of every value at once.
+* **Deterministic stake-weighted injection.**  Round ``it`` injects
+  ``traffic_rate`` new values at origins drawn from the stake-class CDF
+  (the pull subsystem's top-entry ``(bucket+1)^2`` weights) with counter-
+  hash uniforms of ``(impair_seed, it, j)`` — the faults.py discipline, so
+  the schedule replays identically on engine, oracle, resume, and sweeps.
+  Values occupy one of ``traffic_values`` capacity slots; when no slot is
+  free the injection is *dropped* (counted, never silent).
+* **Hop-per-round propagation.**  Unlike the single-value engine's
+  full-BFS-per-round model, a traffic value advances one hop per round:
+  every holder pushes it to its first ``push_fanout`` valid shared-set
+  slots each round.  This is the standard discrete-time push-gossip model
+  and is what makes per-node queue caps meaningful: contention happens
+  *within* a round, across values.
+* **Queue caps create real contention.**  ``node_egress_cap`` bounds the
+  messages a node may put on the wire per round across ALL values (excess
+  candidates are **deferred** — the slot retries next round, a queue);
+  ``node_ingress_cap`` bounds the messages a node accepts per round
+  (excess arrivals are **dropped**).  Per-slot precedence extends the
+  faults.py contract:
+
+      egress-deferred > failed target > partition suppressed >
+      packet loss > ingress-dropped > accepted
+
+  with egress ranked in (value, fanout-slot) order per sender and ingress
+  in (value, source, fanout-slot) order per receiver — both deterministic
+  and identical in the two backends.
+* **Per-value lifecycle.**  A value retires when every node holds it
+  (converged) or when it makes no delivery progress for
+  ``traffic_stall_rounds`` consecutive rounds (stranded/partial); its slot
+  recycles for later injections.  Retirement emits a per-value record
+  (origin, birth, latency in rounds, coverage, message count, RMR) that
+  flows into ``stats/traffic.py``, the ``sim_traffic`` Influx series and
+  the run report.
+
+Determinism contract (the faults.py philosophy): every stochastic choice
+is a *stateless counter hash* — injection origins, packet loss (decorrelated
+per value via ``value_basis``), the shared active-set initialization, and
+the shared rotation schedule (event uniform + candidate draws).  The
+engine's vectorized draws and the oracle's loops share the `*_arr` helpers
+below (identical IEEE f32 arithmetic), so ``TrafficOracle`` is bit-exact
+against the sort-routed engine under loss + churn with rotation ON —
+stronger than the push path's parity tests, which must force rotation off.
+
+With ``traffic_values == 1`` and both caps disabled the traffic subsystem
+is *off*: the CLI runs the unmodified single-value engine and every output
+(stats parity snapshot, Influx wire lines, trace events) is bit-identical
+to the pre-traffic simulator — the same gating contract as pull's
+``gossip_mode=push``.
+
+Everything here is numpy-only: importing this module never touches JAX.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+from .faults import (_GOLD, edge_u32, edge_u32_arr, fmix32, fmix32_arr,
+                     node_u32_arr, partition_active, rate_threshold,
+                     round_basis, stake_bipartition)
+from .pull import PullTables, pull_class_tables
+
+# domain-separation salts for the traffic hash streams (faults.py
+# convention; SHA-256 round constants, distinct from every existing salt)
+SALT_TRAFFIC_OCLASS = 0x52DCE729   # injection origin: stake-class uniform
+SALT_TRAFFIC_OMEMBER = 0x1F83D9AB  # injection origin: within-class uniform
+SALT_TRAFFIC_LOSS = 0x5BE0CD19     # per-(value, src, dst) packet loss
+SALT_TRAFFIC_ROT = 0x428A2F98      # shared rotation: per-node event uniform
+SALT_TRAFFIC_RCLASS = 0x71374491   # rotation candidate: class uniform
+SALT_TRAFFIC_RMEMBER = 0xB5C0FBCF  # rotation candidate: member uniform
+SALT_TRAFFIC_ICLASS = 0xE9B5DBA5   # shared-set init: class uniform
+SALT_TRAFFIC_IMEMBER = 0x3956C25B  # shared-set init: member uniform
+
+# per-candidate-slot outcome codes.  0-4 are the flight-recorder TRACE_*
+# codes (obs/trace.py) so stats/edges.py explain-stranded reads traffic
+# events unchanged; 5-6 are the queue-cap outcomes this subsystem adds
+# (trace schema v3).
+TRAFFIC_EMPTY = 0            # no candidate in this slot
+TRAFFIC_ACCEPTED = 1         # == TRACE_CANDIDATE: arrived and accepted
+TRAFFIC_FAILED_TARGET = 2    # == TRACE_FAILED_TARGET
+TRAFFIC_SUPPRESSED = 3       # == TRACE_SUPPRESSED (partition)
+TRAFFIC_DROPPED = 4          # == TRACE_DROPPED (packet loss)
+TRAFFIC_DEFERRED = 5         # sender's node_egress_cap exhausted (queued)
+TRAFFIC_QUEUE_DROPPED = 6    # receiver's node_ingress_cap exhausted
+TRAFFIC_CODE_NAMES = {
+    TRAFFIC_EMPTY: "empty",
+    TRAFFIC_ACCEPTED: "accepted",
+    TRAFFIC_FAILED_TARGET: "failed_target",
+    TRAFFIC_SUPPRESSED: "suppressed",
+    TRAFFIC_DROPPED: "dropped",
+    TRAFFIC_DEFERRED: "deferred",
+    TRAFFIC_QUEUE_DROPPED: "queue_dropped",
+}
+
+_M32 = 0xFFFFFFFF
+
+
+def value_basis(basis: int, vid: int) -> int:
+    """Decorrelate a per-round hash basis per value id (scalar path).
+
+    Without this, two values crossing the same edge in the same round
+    would share one loss coin — a correlated "link down" model.  Folding
+    the (globally unique, monotone) value id in gives every value an
+    independent stream while staying stateless and replayable."""
+    return fmix32((basis ^ ((vid * _GOLD) & _M32)) & _M32)
+
+
+def value_basis_arr(basis, vid, xp=np):
+    """``value_basis`` on uint32 lanes (vid array -> basis array)."""
+    return fmix32_arr(basis ^ (vid.astype(xp.uint32) * xp.uint32(_GOLD)), xp)
+
+
+def u01_arr(h, xp=np):
+    """u32 hash array -> f32 uniforms in [0, 1): ``(h >> 8) * 2^-24``.
+
+    The 24 surviving bits fit the f32 mantissa exactly, so numpy (oracle)
+    and jax.numpy (engine) lanes produce identical values (pull.py
+    ``u01_from_u32`` is the scalar twin)."""
+    return (h >> xp.uint32(8)).astype(xp.float32) * xp.float32(2.0 ** -24)
+
+
+class TrafficTables(NamedTuple):
+    """Stake-class sampling tables for every traffic draw (numpy).
+
+    Wraps the pull subsystem's top-entry class CDF (``(bucket+1)^2``
+    weights) — injection origins, the shared active set and rotation
+    candidates are all origin-independent draws, exactly the profile the
+    pull sampler already factorizes.  The engine mirrors these arrays onto
+    the device; both backends run :func:`class_draw_arr` over them."""
+
+    perm: np.ndarray         # [N] i32 node ids sorted by bucket (stable)
+    class_start: np.ndarray  # [NB] i32
+    class_count: np.ndarray  # [NB] i32
+    cdf: np.ndarray          # [NB] f32 inclusive CDF, cdf[-1] == 1.0
+
+
+def traffic_tables(stakes) -> TrafficTables:
+    pt: PullTables = pull_class_tables(stakes)
+    return TrafficTables(perm=pt.perm, class_start=pt.class_start,
+                         class_count=pt.class_count, cdf=pt.cdf)
+
+
+def class_draw_arr(tables, u_cls, u_mem, xp=np):
+    """Vectorized stake-weighted node draw, shared by both backends.
+
+    ``u_cls``/``u_mem``: f32 uniform arrays of any (equal) shape; returns
+    the drawn node ids (same shape, i32; may include the drawer itself —
+    callers discard self-draws).  All arithmetic is f32/i32-exact between
+    numpy and jax.numpy lanes: a class compare against the shared CDF, a
+    ``floor(u * count)`` within the class, and a permutation gather."""
+    cdf = xp.asarray(tables.cdf)
+    start = xp.asarray(tables.class_start)
+    count = xp.asarray(tables.class_count)
+    perm = xp.asarray(tables.perm)
+    nb = tables.cdf.shape[0]
+    shape = u_cls.shape
+    uc = u_cls.reshape(-1)
+    um = u_mem.reshape(-1)
+    cls = xp.sum((uc[:, None] >= cdf[None, :-1]).astype(xp.int32), axis=-1)
+    oh = (cls[:, None] == xp.arange(nb, dtype=xp.int32)[None, :])
+    ohf = oh.astype(xp.float32)
+    cstart = xp.einsum("xc,c->x", ohf,
+                       start.astype(xp.float32)).astype(xp.int32)
+    ccount = xp.einsum("xc,c->x", ohf,
+                       count.astype(xp.float32)).astype(xp.int32)
+    pos = cstart + xp.floor(um * ccount.astype(xp.float32)).astype(xp.int32)
+    pos = xp.minimum(pos, cstart + xp.maximum(ccount - 1, 0))
+    return perm[pos].reshape(shape)
+
+
+def build_shared_active_set(stakes, seed: int, active_set_size: int,
+                            init_draws: int) -> np.ndarray:
+    """The ONE [N, S] active set every traffic value pushes through.
+
+    Per node: ``init_draws`` stake-weighted candidate draws (counter
+    hashes of ``(seed, node, draw)`` under the init salts), keeping the
+    first S distinct non-self candidates.  Unfilled slots hold N (empty).
+    Pure numpy and deterministic, so both backends call this exact
+    function — shared-code parity rather than dual implementations."""
+    stakes = np.asarray(stakes, dtype=np.int64)
+    n = int(stakes.shape[0])
+    s = int(active_set_size)
+    e = int(init_draws)
+    tables = traffic_tables(stakes)
+    b_ic = round_basis(seed, 0, SALT_TRAFFIC_ICLASS)
+    b_im = round_basis(seed, 0, SALT_TRAFFIC_IMEMBER)
+    nodes_u = np.arange(n, dtype=np.uint32)[:, None]
+    draws_u = np.arange(e, dtype=np.uint32)[None, :]
+    u_cls = u01_arr(edge_u32_arr(np.uint32(b_ic), nodes_u, draws_u))
+    u_mem = u01_arr(edge_u32_arr(np.uint32(b_im), nodes_u, draws_u))
+    cands = class_draw_arr(tables, u_cls, u_mem)          # [N, E]
+    active = np.full((n, s), n, np.int32)
+    cnt = np.zeros(n, np.int32)
+    self_idx = np.arange(n, dtype=np.int32)
+    for d in range(e):
+        c = cands[:, d].astype(np.int32)
+        dup = np.any(active == c[:, None], axis=-1) | (c == self_idx)
+        ins = (~dup) & (cnt < s)
+        slot = np.minimum(cnt, s - 1)
+        active[np.nonzero(ins)[0], slot[ins]] = c[ins]
+        cnt += ins.astype(np.int32)
+    return active
+
+
+class TrafficRound(NamedTuple):
+    """One round's traffic outcome (oracle side; the engine's
+    ``traffic_round_step`` emits the same quantities as rows)."""
+
+    injected: int            # values injected this round
+    inject_dropped: int      # injections lost to a full slot table
+    live: int                # live values AFTER injection+retirement
+    sends: int               # messages put on the wire (egress-cap survivors)
+    deferred: int            # candidates deferred by node_egress_cap
+    failed_target: int       # sends into churn-failed targets
+    suppressed: int          # partition-suppressed sends
+    dropped: int             # loss-dropped sends
+    arrived: int             # sends that reached a live receiver
+    queue_dropped: int       # arrivals dropped by node_ingress_cap
+    accepted: int            # arrivals accepted (delivered + redundant)
+    delivered: int           # first deliveries (new (value, node) pairs)
+    redundant: int           # accepted copies beyond the first delivery
+    prunes_sent: int         # prune messages across values
+    retired: int             # values retired this round
+    converged: int           # retired with full coverage
+    hop_clamped: int         # first deliveries whose true hop exceeded H-1
+    qdepth_max: int          # max per-node deferred count this round
+    inflow_max: int          # max per-node accepted ingress this round
+    records: list            # retirement record dicts (see retire_record)
+    node_deferred: np.ndarray      # [N] i64 deferrals per sender
+    node_queue_dropped: np.ndarray  # [N] i64 ingress drops per receiver
+
+
+def retire_record(vid, origin, birth, it, holders, n, m_msgs, full,
+                  hops_sum) -> dict:
+    """The per-value retirement record both backends emit (and the stats
+    layer, Influx series, and run report consume).  ``latency_rounds``
+    counts rounds in flight inclusive of the injection round; RMR follows
+    the push path's ``m/(n-1) - 1`` with m = accepted messages + prunes."""
+    holders = int(holders)
+    return {
+        "vid": int(vid),
+        "origin": int(origin),
+        "birth": int(birth),
+        "retired_at": int(it),
+        "latency_rounds": int(it) - int(birth) + 1,
+        "holders": holders,
+        "coverage": holders / float(n),
+        "m": int(m_msgs),
+        "rmr": (m_msgs / (holders - 1) - 1.0) if holders > 1 else 0.0,
+        "converged": bool(full),
+        "mean_hop": (hops_sum / holders) if holders > 0 else 0.0,
+    }
+
+
+class TrafficOracle:
+    """CPU-oracle traffic engine: the identical spec as
+    ``engine/traffic.py traffic_round_step``, implemented as plain
+    per-value / per-node / per-slot loops — an independent formulation the
+    1k-node parity test (tests/test_traffic.py) checks the sort-routed
+    engine against bit-for-bit, including rotation (hash-based here, so it
+    needs no forced-identical-active-set scaffolding).
+
+    State layout mirrors the engine's ``TrafficState``: ``slots`` holds
+    per-value dicts (None = free slot), everything shared lives on the
+    instance.  ``run_round`` returns a :class:`TrafficRound`.
+    """
+
+    def __init__(self, stakes, *, seed: int = 42, impair_seed: int = 0,
+                 traffic_values: int = 8, traffic_rate: int = 1,
+                 node_ingress_cap: int = 0, node_egress_cap: int = 0,
+                 traffic_stall_rounds: int = 3,
+                 push_fanout: int = 6, active_set_size: int = 12,
+                 init_draws: int = 64, k_inbound: int = 16,
+                 received_cap: int = 50, rc_slots: int = 64,
+                 min_num_upserts: int = 20,
+                 prune_stake_threshold: float = 0.15,
+                 min_ingress_nodes: int = 2,
+                 probability_of_rotation: float = 0.013333,
+                 rot_tries: int = 8, hist_bins: int = 64,
+                 packet_loss_rate: float = 0.0,
+                 churn_fail_rate: float = 0.0,
+                 churn_recover_rate: float = 0.0,
+                 partition_at: int = -1, heal_at: int = -1):
+        stakes = np.asarray(stakes, dtype=np.int64)
+        self.stakes = stakes
+        self.n = int(stakes.shape[0])
+        self.tables = traffic_tables(stakes)
+        self.seed = int(seed)
+        self.impair_seed = int(impair_seed)
+        self.mv = int(traffic_values)
+        self.rate = int(traffic_rate)
+        self.ingress_cap = int(node_ingress_cap)
+        self.egress_cap = int(node_egress_cap)
+        self.stall_rounds = int(traffic_stall_rounds)
+        self.fanout = min(int(push_fanout), int(active_set_size))
+        self.s = int(active_set_size)
+        self.k_inbound = int(k_inbound)
+        self.received_cap = int(received_cap)
+        self.rc_slots = int(rc_slots)
+        self.min_num_upserts = int(min_num_upserts)
+        self.prune_stake_threshold = float(prune_stake_threshold)
+        self.min_ingress_nodes = int(min_ingress_nodes)
+        self.rot_prob = np.float32(probability_of_rotation)
+        self.rot_tries = int(rot_tries)
+        self.hist_bins = int(hist_bins)
+        self.loss_thr = rate_threshold(packet_loss_rate)
+        self.fail_thr = rate_threshold(churn_fail_rate)
+        self.recover_thr = rate_threshold(churn_recover_rate)
+        self.partition_at = int(partition_at)
+        self.heal_at = int(heal_at)
+        self.side = (stake_bipartition(stakes)
+                     if self.partition_at >= 0 else None)
+
+        self.active = build_shared_active_set(stakes, self.seed, self.s,
+                                              init_draws)
+        self.failed = np.zeros(self.n, bool)
+        self.next_vid = 0
+        # per-value slots: None = free, else a dict of per-value state
+        self.slots = [None] * self.mv
+
+    # -- per-value slot state ---------------------------------------------
+
+    def _fresh_slot(self, vid: int, origin: int, it: int) -> dict:
+        holder = np.zeros(self.n, bool)
+        holder[origin] = True
+        hop = np.full(self.n, -1, np.int32)
+        hop[origin] = 0
+        return {
+            "vid": vid, "origin": origin, "birth": it, "stall": 0,
+            "holder": holder, "hop": hop, "m": 0,
+            "pruned": np.zeros((self.n, self.s), bool),
+            # received cache: per node, {src: [score, stake]} + upserts
+            "rc": [dict() for _ in range(self.n)],
+            "rc_upserts": np.zeros(self.n, np.int32),
+        }
+
+    # -- the round --------------------------------------------------------
+
+    def churn_step(self, it: int) -> None:
+        if self.fail_thr == 0 and self.recover_thr == 0:
+            return
+        from .faults import SALT_CHURN, node_u32
+        basis = round_basis(self.impair_seed, it, SALT_CHURN)
+        for i in range(self.n):
+            u = node_u32(basis, i)
+            if self.failed[i]:
+                if u < self.recover_thr:
+                    self.failed[i] = False
+            elif u < self.fail_thr:
+                self.failed[i] = True
+
+    def inject(self, it: int):
+        """Round-start injection; returns (injected, dropped)."""
+        rate = max(0, min(self.rate, self.mv))
+        free = [m for m in range(self.mv) if self.slots[m] is None]
+        n_inj = min(rate, len(free))
+        from .faults import node_u32
+        from .pull import u01_from_u32
+        b_oc = round_basis(self.impair_seed, it, SALT_TRAFFIC_OCLASS)
+        b_om = round_basis(self.impair_seed, it, SALT_TRAFFIC_OMEMBER)
+        for j in range(n_inj):
+            u_cls = u01_from_u32(node_u32(b_oc, j))
+            u_mem = u01_from_u32(node_u32(b_om, j))
+            origin = int(class_draw_arr(self.tables,
+                                        np.asarray([u_cls], np.float32),
+                                        np.asarray([u_mem], np.float32))[0])
+            self.slots[free[j]] = self._fresh_slot(self.next_vid + j,
+                                                   origin, it)
+        self.next_vid += n_inj
+        return n_inj, rate - n_inj
+
+    def run_round(self, it: int) -> TrafficRound:
+        n, s, f = self.n, self.s, self.fanout
+        self.churn_step(it)
+        injected, inject_dropped = self.inject(it)
+        live_slots = [m for m in range(self.mv) if self.slots[m] is not None]
+
+        part_on = (self.side is not None
+                   and partition_active(it, self.partition_at, self.heal_at))
+        b_loss = round_basis(self.impair_seed, it, SALT_TRAFFIC_LOSS)
+
+        # ---- candidate pushes, egress cap, network classification -------
+        # (value asc, sender, fanout-slot asc) walk == the engine's
+        # m-major egress ranking per sender
+        egress_used = np.zeros(n, np.int64)
+        node_deferred = np.zeros(n, np.int64)
+        node_qdrop = np.zeros(n, np.int64)
+        sends = deferred = failed_target = suppressed = dropped = 0
+        arrivals = []   # (value-slot m, src, fanout-slot, dst) in order
+        for m in live_slots:
+            v = self.slots[m]
+            vb = value_basis(b_loss, v["vid"])
+            for src in range(n):
+                if not v["holder"][src] or self.failed[src]:
+                    continue
+                used_f = 0
+                for slot in range(s):
+                    peer = int(self.active[src, slot])
+                    if peer >= n or v["pruned"][src, slot] \
+                            or peer == v["origin"]:
+                        continue
+                    if used_f >= f:
+                        break
+                    used_f += 1
+                    # a candidate occupies a fanout slot; egress cap next
+                    if 0 < self.egress_cap <= egress_used[src]:
+                        deferred += 1
+                        node_deferred[src] += 1
+                        continue
+                    egress_used[src] += 1
+                    sends += 1
+                    if self.failed[peer]:
+                        failed_target += 1
+                        continue
+                    if part_on and self.side[src] != self.side[peer]:
+                        suppressed += 1
+                        continue
+                    if (self.loss_thr
+                            and edge_u32(vb, src, peer) < self.loss_thr):
+                        dropped += 1
+                        continue
+                    arrivals.append((m, src, peer))
+        arrived = len(arrivals)
+
+        # ---- ingress cap in (value, src, slot) arrival order ------------
+        ingress_used = np.zeros(n, np.int64)
+        accepted = []   # (m, src, dst)
+        queue_dropped = 0
+        for (m, src, dst) in arrivals:
+            if 0 < self.ingress_cap <= ingress_used[dst]:
+                queue_dropped += 1
+                node_qdrop[dst] += 1
+                continue
+            ingress_used[dst] += 1
+            accepted.append((m, src, dst))
+
+        # ---- per-value inbound ranking, delivery, rc merge, prunes ------
+        h_clamp = self.hist_bins - 1
+        n_accepted = len(accepted)
+        prunes_sent = hop_clamped = 0
+        progress = {m: 0 for m in live_slots}
+        inbound = {}   # (m, dst) -> [(clamped hop, src, true hop)]
+        for (m, src, dst) in accepted:
+            v = self.slots[m]
+            th = int(v["hop"][src]) + 1
+            inbound.setdefault((m, dst), []).append(
+                (min(th, h_clamp), src, th))
+            v["m"] += 1
+
+        new_hops = {}
+        for (m, dst), lst in inbound.items():
+            v = self.slots[m]
+            lst.sort(key=lambda e: (e[0], e[1]))
+            lst[:] = lst[:self.k_inbound]    # the engine's k_inbound width
+            if not v["holder"][dst]:
+                ch, _, th = lst[0]
+                new_hops[(m, dst)] = ch
+                progress[m] += 1
+                if th > h_clamp:
+                    hop_clamped += 1
+            # received-cache merge (engine verb-2 tail semantics)
+            rc = v["rc"][dst]
+            length = len(rc)
+            for r, (_, src, _) in enumerate(lst):
+                if src in rc:
+                    if r < 2:
+                        rc[src][0] += 1
+                elif (r < 2) or (length < self.received_cap):
+                    rc[src] = [1 if r < 2 else 0, int(self.stakes[src])]
+                    length += 1
+            if len(rc) > self.rc_slots:
+                # physical-slot eviction: keep the rc_slots smallest ids
+                for src in sorted(rc)[self.rc_slots:]:
+                    del rc[src]
+            v["rc_upserts"][dst] += 1
+        for (m, dst), hp in new_hops.items():
+            v = self.slots[m]
+            v["holder"][dst] = True
+            v["hop"][dst] = hp
+        # first deliveries = new (value, node) pairs; every further
+        # accepted copy (same-round duplicates included) is redundant
+        delivered = len(new_hops)
+        redundant = n_accepted - delivered
+
+        # ---- prune decide + apply (per value, engine verbs 3-4) ---------
+        for m in live_slots:
+            v = self.slots[m]
+            fired = np.nonzero(v["rc_upserts"] >= self.min_num_upserts)[0]
+            for pruner in fired.tolist():
+                rc = v["rc"][pruner]
+                min_stake = min(int(self.stakes[pruner]),
+                                int(self.stakes[v["origin"]]))
+                min_ingress_stake = int(
+                    np.float64(min_stake)
+                    * np.float64(self.prune_stake_threshold))
+                order = sorted(rc.items(),
+                               key=lambda kv: (-kv[1][0], -kv[1][1], kv[0]))
+                cum = 0
+                for pos, (src, (_, stake)) in enumerate(order):
+                    if (pos >= self.min_ingress_nodes
+                            and cum >= min_ingress_stake
+                            and src != v["origin"]):
+                        prunes_sent += 1
+                        v["m"] += 1
+                        # prune apply: every shared slot of src that
+                        # points at the pruner gets the per-value bit
+                        for slot in range(s):
+                            if int(self.active[src, slot]) == pruner:
+                                v["pruned"][src, slot] = True
+                    cum += stake
+                v["rc"][pruner] = dict()
+                v["rc_upserts"][pruner] = 0
+
+        # ---- shared rotation (one schedule for every value) -------------
+        b_rot = round_basis(self.impair_seed, it, SALT_TRAFFIC_ROT)
+        b_rc = round_basis(self.impair_seed, it, SALT_TRAFFIC_RCLASS)
+        b_rm = round_basis(self.impair_seed, it, SALT_TRAFFIC_RMEMBER)
+        nodes_u = np.arange(n, dtype=np.uint32)[:, None]
+        tries_u = np.arange(self.rot_tries, dtype=np.uint32)[None, :]
+        u_rot = u01_arr(node_u32_arr(np.uint32(b_rot),
+                                     np.arange(n, dtype=np.uint32)))
+        cands = class_draw_arr(
+            self.tables,
+            u01_arr(edge_u32_arr(np.uint32(b_rc), nodes_u, tries_u)),
+            u01_arr(edge_u32_arr(np.uint32(b_rm), nodes_u, tries_u)))
+        for node in range(n):
+            if not (u_rot[node] < self.rot_prob):
+                continue
+            chosen = -1
+            row = self.active[node]
+            for t in range(self.rot_tries):
+                c = int(cands[node, t])
+                if c != node and not (row == c).any():
+                    chosen = c
+                    break
+            if chosen < 0:
+                continue
+            cnt = int((row < n).sum())
+            if cnt >= s:
+                self.active[node, :-1] = row[1:].copy()
+                self.active[node, -1] = chosen
+                for m in live_slots:
+                    pr = self.slots[m]["pruned"]
+                    pr[node, :-1] = pr[node, 1:].copy()
+                    pr[node, -1] = False
+            else:
+                self.active[node, cnt] = chosen
+
+        # ---- stall / retirement / recycle -------------------------------
+        records = []
+        retired = converged = 0
+        for m in live_slots:
+            v = self.slots[m]
+            if v["birth"] == it or progress[m] > 0:
+                v["stall"] = 0
+            else:
+                v["stall"] += 1
+            holders = int(v["holder"].sum())
+            full = holders == n
+            if full or v["stall"] >= self.stall_rounds:
+                records.append(retire_record(
+                    v["vid"], v["origin"], v["birth"], it, holders, n,
+                    v["m"], full,
+                    int(v["hop"][v["holder"]].sum())))
+                retired += 1
+                converged += int(full)
+                self.slots[m] = None
+        live = sum(sl is not None for sl in self.slots)
+
+        return TrafficRound(
+            injected=injected, inject_dropped=inject_dropped, live=live,
+            sends=sends, deferred=deferred, failed_target=failed_target,
+            suppressed=suppressed, dropped=dropped, arrived=arrived,
+            queue_dropped=queue_dropped, accepted=n_accepted,
+            delivered=delivered, redundant=redundant,
+            prunes_sent=prunes_sent, retired=retired, converged=converged,
+            hop_clamped=hop_clamped,
+            qdepth_max=int(node_deferred.max()) if n else 0,
+            inflow_max=int(ingress_used.max()) if n else 0,
+            records=records, node_deferred=node_deferred,
+            node_queue_dropped=node_qdrop)

@@ -30,3 +30,10 @@ def _fresh_pubkey_counter():
     at 1 in each test."""
     reset_unique_pubkeys()
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy end-to-end tests excluded from the tier-1 "
+        "'-m not slow' suite (still run by a plain pytest invocation)")

@@ -1,13 +1,16 @@
-"""Generator for the committed v1-v4 checkpoint fixtures (run once).
+"""Generator for the committed v1-v5 checkpoint fixtures (run once).
 
 The fixtures pin the forward-compat contract: every checkpoint format the
 project ever shipped must stay loadable by ``load_state`` /
 ``restore_sim_state`` forever (tests/test_checkpoint.py matrix).  They
 are COMMITTED BINARIES — regenerating them with a newer engine would
 defeat the point, so this script exists only to document how they were
-made (v5-era engine, 2026-08) and to rebuild them if the fixture cluster
-spec itself ever has to change (requires re-validating against the old
-loaders).
+made (v1-v4: v5-era engine, 2026-08; v5: v6-era engine, 2026-08 — the
+SimState array set and the 16-node fixture dynamics are unchanged between
+those eras, so the file is byte-faithful to what a v5 writer produced)
+and to rebuild them if the fixture cluster spec itself ever has to change
+(requires re-validating against the old loaders).  Existing fixture files
+are never overwritten — delete one explicitly to regenerate it.
 
 Each fixture holds:
   * ``state.*``      — SimState arrays after 3 rounds on a 16-node seeded
@@ -41,6 +44,9 @@ IMPAIR_KEYS = ("packet_loss_rate", "churn_fail_rate", "churn_recover_rate",
                "partition_at", "heal_at", "impair_seed")
 PULL_KEYS = ("gossip_mode", "pull_fanout", "pull_interval",
              "pull_bloom_fp_rate", "pull_request_cap", "pull_slots")
+# v6 (concurrent traffic) params that did not exist in the v5 era
+TRAFFIC_KEYS = ("traffic_values", "traffic_rate", "node_ingress_cap",
+                "node_egress_cap", "traffic_stall_rounds")
 
 
 def main():
@@ -69,6 +75,9 @@ def main():
         meta = {"format_version": version, "params": p, "iteration": 3}
         meta.update(meta_extra)
         path = os.path.join(HERE, f"v{version}.npz")
+        if os.path.exists(path):
+            print(f"keep  {path} (committed fixture; delete to regenerate)")
+            return
         np.savez_compressed(
             path, __meta__=np.frombuffer(json.dumps(meta).encode(),
                                          dtype=np.uint8),
@@ -77,10 +86,15 @@ def main():
 
     impair = {k: pdict[k] for k in IMPAIR_KEYS}
     pull = {k: pdict[k] for k in PULL_KEYS if k != "pull_slots"}
-    write(1, V1_MISSING, IMPAIR_KEYS + PULL_KEYS, {})
-    write(2, PRE_V4_MISSING, IMPAIR_KEYS + PULL_KEYS, {})
-    write(3, PRE_V4_MISSING, PULL_KEYS, {"impair": impair})
-    write(4, (), (), {"impair": impair, "pull": pull})
+    write(1, V1_MISSING, IMPAIR_KEYS + PULL_KEYS + TRAFFIC_KEYS, {})
+    write(2, PRE_V4_MISSING, IMPAIR_KEYS + PULL_KEYS + TRAFFIC_KEYS, {})
+    write(3, PRE_V4_MISSING, PULL_KEYS + TRAFFIC_KEYS, {"impair": impair})
+    write(4, (), TRAFFIC_KEYS, {"impair": impair, "pull": pull})
+    # v5: same array set as v4 + the resilience meta block (PR 7); the
+    # traffic params of the v6 era do not exist in a v5-era params dict
+    write(5, (), TRAFFIC_KEYS,
+          {"impair": impair, "pull": pull,
+           "resilience": {"journal": "", "committed_units": 0}})
 
 
 if __name__ == "__main__":

@@ -54,6 +54,7 @@ def test_all_origins_uneven_final_batch_padding():
     assert summary["measured_points"] == 2 * 50
 
 
+@pytest.mark.slow  # tier-1 budget; tools/sweep_smoke gate covers this
 def test_all_origins_tail_batch_padded_to_one_compiled_shape():
     """ISSUE 4: the tail chunk is padded to the full origin_batch width, so
     the whole run compiles at most one batch shape; padded sims are counted

@@ -113,7 +113,7 @@ def test_v3_checkpoint_records_impair_block(tmp_path):
     path = str(tmp_path / "ckpt.npz")
     save_state(path, state, params, iteration=4)
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 5
+    assert meta["format_version"] == 6
     assert meta["impair"] == {
         "packet_loss_rate": 0.25, "churn_fail_rate": 0.01,
         "churn_recover_rate": 0.5, "partition_at": 3, "heal_at": 8,
@@ -236,11 +236,12 @@ def test_impair_knob_mismatch_warns_on_resume(tmp_path, caplog):
 FIXTURE_DIR = __file__.rsplit("/", 1)[0] + "/fixtures/checkpoints"
 
 
-@pytest.mark.parametrize("version", [1, 2, 3, 4])
+@pytest.mark.parametrize("version", [1, 2, 3, 4, 5])
 def test_checkpoint_forward_compat_matrix(version):
-    """Committed v1-v4 fixture files (tests/fixtures/checkpoints, frozen
-    binaries from each format era) must load and restore forever — v5 can
-    never silently orphan old checkpoints (ISSUE 7).  Each fixture must
+    """Committed v1-v5 fixture files (tests/fixtures/checkpoints, frozen
+    binaries from each format era) must load and restore forever — a new
+    format can never silently orphan old checkpoints (ISSUE 7; v5 joined
+    the matrix when checkpoint v6 landed, ISSUE 10).  Each fixture must
     (a) pass load_state's validation against current EngineParams,
     (b) restore to a full SimState with the era-appropriate backfills,
     (c) continue running on the current engine."""
@@ -265,7 +266,12 @@ def test_checkpoint_forward_compat_matrix(version):
         assert meta["impair"]["partition_at"] == -1
     if version < 4:
         assert meta["pull"]["gossip_mode"] == "push"
-    assert meta["resilience"] == {}
+    if version < 5:
+        assert meta["resilience"] == {}
+    # pre-v6 backfills: traffic off, kind "sim"
+    assert meta["traffic"]["traffic_values"] == 1
+    assert meta["traffic"]["node_ingress_cap"] == 0
+    assert meta["kind"] == "sim"
 
     restored, _, _ = restore_sim_state(path, params, tables)
     for f in restored._fields:
@@ -290,7 +296,7 @@ def test_v5_checkpoint_records_resilience_block(tmp_path):
     save_state(path, state, params, iteration=2,
                resilience={"journal": "ckpt.journal", "committed_units": 3})
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 5
+    assert meta["format_version"] == 6
     assert meta["resilience"] == {"journal": "ckpt.journal",
                                   "committed_units": 3}
 
@@ -323,3 +329,48 @@ def test_cli_kill_and_resume_bit_identical(tmp_path):
             if k == "__meta__":
                 continue
             np.testing.assert_array_equal(zf[k], zp[k], err_msg=k)
+
+
+def test_v6_traffic_checkpoint_roundtrip_and_kind_guard(tmp_path):
+    """kind="traffic" v6 checkpoints: TrafficState + serialized
+    TrafficStats round-trip exactly, and the two restore entry points
+    refuse each other's kinds with a clear error (ISSUE 10)."""
+    from gossip_sim_tpu.checkpoint import (restore_traffic_state,
+                                           save_traffic_state)
+    from gossip_sim_tpu.engine.traffic import (device_traffic_tables,
+                                               init_traffic_state,
+                                               run_traffic_rounds)
+
+    rng = np.random.default_rng(5)
+    stakes = rng.integers(1, 1 << 16, 16).astype(np.int64) * 10**9
+    tables = make_cluster_tables(stakes)
+    tparams = EngineParams(num_nodes=16, traffic_values=3, traffic_rate=1,
+                           node_ingress_cap=4, warm_up_rounds=0).validate()
+    tt = device_traffic_tables(stakes)
+    tstate = init_traffic_state(stakes, tparams, seed=3)
+    tstate, _ = run_traffic_rounds(tparams, tables, tt, tstate, 3)
+    path = str(tmp_path / "traffic.npz")
+    stats_state = {"iterations": [0, 1, 2], "rounds": {}, "records": [],
+                   "final": {}}
+    save_traffic_state(path, tstate, tparams, iteration=3,
+                       traffic_stats=stats_state)
+    restored, stored, meta = restore_traffic_state(path, tparams)
+    assert meta["kind"] == "traffic"
+    assert meta["format_version"] == 6
+    assert meta["traffic"]["traffic_values"] == 3
+    assert meta["traffic_stats"]["iterations"] == [0, 1, 2]
+    for f, a, b in zip(restored._fields, restored, tstate):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=f)
+    # continuation runs on the restored state
+    st2, rows = run_traffic_rounds(tparams, tables, tt, restored, 2,
+                                   start_it=3)
+    assert np.asarray(rows["injected"]).shape[0] == 2
+    # kind guards, both directions
+    with pytest.raises(ValueError, match="traffic"):
+        restore_sim_state(path, EngineParams(num_nodes=16))
+    params, tables16, origins, state = _setup()
+    sim_path = str(tmp_path / "sim.npz")
+    save_state(sim_path, state, params, iteration=1)
+    with pytest.raises(ValueError, match="sim"):
+        restore_traffic_state(sim_path)

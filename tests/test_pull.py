@@ -76,6 +76,7 @@ class TestModeGating:
                                           err_msg=f)
         assert (np.asarray(s1.pull_rescued_acc) == 0).all()
 
+    @pytest.mark.slow  # tier-1 budget; tools/pull_smoke gate covers this
     def test_push_pull_leaves_push_phase_untouched(self):
         """The pull phase runs AFTER the push BFS and feeds nothing back
         into active sets / received caches, so the push rows (dist, m, n,
@@ -100,6 +101,7 @@ class TestModeGating:
             r_pp["pull_responses"] + r_pp["pull_misses"])
         assert r_pp["pull_requests"].sum() > 0
 
+    @pytest.mark.slow  # tier-1 budget; tools/pull_smoke gate covers this
     def test_pull_only_mode_pushes_nothing(self):
         p = EngineParams(num_nodes=self.N, warm_up_rounds=0,
                          gossip_mode="pull", pull_fanout=4)
@@ -111,6 +113,7 @@ class TestModeGating:
         assert (rows["coverage"] * self.N
                 == 1 + rows["pull_rescued"]).all()
 
+    @pytest.mark.slow  # tier-1 budget; tools/pull_smoke gate covers this
     def test_pull_interval_gates_rounds(self):
         p = EngineParams(num_nodes=self.N, warm_up_rounds=0,
                          gossip_mode="push-pull", pull_interval=3)
@@ -207,6 +210,7 @@ class TestDeterminism:
 class TestPullCompileOnce:
     N = 96
 
+    @pytest.mark.slow  # tier-1 budget; tools/sweep_smoke + pull_smoke gate covers this
     def test_pull_knob_sweep_compiles_exactly_once(self):
         """A 3-step PULL_FANOUT sweep (plus interval/fp/cap steps) within
         the static pull_slots width builds ONE executable (the acceptance

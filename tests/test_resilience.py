@@ -131,6 +131,7 @@ def test_journal_overwrites_without_resume(tmp_path, caplog):
 # snapshot serialization + stats restoration
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget; tools/resume_smoke gate covers this
 def test_snapshot_json_roundtrip_and_restore():
     """A finished sim's parity snapshot must survive
     JSON-serialize -> JSON-parse -> restore_stats exactly — including
@@ -179,6 +180,7 @@ def _run_sweep(cfg, num_sims=4, kill_after=0):
     return coll, dpq.drain_deterministic_lines()
 
 
+@pytest.mark.slow  # tier-1 budget; tools/resume_smoke gate covers this
 def test_serial_sweep_kill_and_resume_bit_exact(tmp_path):
     coll_a, lines_a = _run_sweep(_sweep_cfg())
 
@@ -195,6 +197,7 @@ def test_serial_sweep_kill_and_resume_bit_exact(tmp_path):
     assert reg.counter("resilience/committed_units") == 2  # sims 2, 3
 
 
+@pytest.mark.slow  # tier-1 budget; tools/resume_smoke gate covers this
 def test_lane_sweep_kill_and_resume_bit_exact(tmp_path):
     cfg = _sweep_cfg(num_simulations=5, sweep_lanes=2)
     coll_a, lines_a = _run_sweep(cfg, 5)
@@ -214,6 +217,7 @@ def test_lane_sweep_kill_and_resume_bit_exact(tmp_path):
     assert get_registry().counter("engine/compiles") == 1
 
 
+@pytest.mark.slow  # tier-1 budget; tools/resume_smoke gate covers this
 def test_all_origins_kill_and_resume_bit_exact(tmp_path):
     from gossip_sim_tpu.cli import run_all_origins
 
@@ -247,6 +251,7 @@ def test_all_origins_kill_and_resume_bit_exact(tmp_path):
     assert lines_a == lines_c
 
 
+@pytest.mark.slow  # tier-1 budget; tools/resume_smoke gate covers this
 def test_all_origins_sidecar_ahead_of_journal_reconciles(tmp_path):
     """A kill between the sidecar save and the journal commit leaves the
     aggregate one batch ahead; resume must commit the missing record
@@ -282,6 +287,7 @@ def test_all_origins_sidecar_ahead_of_journal_reconciles(tmp_path):
         assert s_a[k] == s_c[k], k
 
 
+@pytest.mark.slow  # tier-1 budget; tools/resume_smoke gate covers this
 def test_origin_rank_sweep_kill_and_resume_bit_exact(tmp_path, monkeypatch):
     import gossip_sim_tpu.cli as cli
 
@@ -435,6 +441,7 @@ def test_injected_device_failure_retries_to_correct_stats():
     assert get_registry().counter("resilience/device_failures") >= 2
 
 
+@pytest.mark.slow  # tier-1 budget; tools/resume_smoke gate covers this
 def test_injected_failure_cpu_fallback_flags_report():
     """Acceptance: --on-device-failure cpu-fallback completes the unit
     with correct stats and the run report flags it."""
@@ -499,6 +506,7 @@ def test_abort_exits_with_resumable_code_and_committed_journal(tmp_path):
     assert [r["unit"] for r in recs[1:]] == [0]
 
 
+@pytest.mark.slow  # tier-1 budget; tools/resume_smoke gate covers this
 def test_cli_sigterm_returns_resumable_exit_code(tmp_path, monkeypatch):
     """kill-after-units (via the env hook — main() resets programmatic
     shutdown state on entry) sends a real SIGTERM through signal_guard;
@@ -530,6 +538,7 @@ def test_cli_sigterm_returns_resumable_exit_code(tmp_path, monkeypatch):
 # single-run autosave + satellites
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow  # tier-1 budget; tools/resume_smoke gate covers this
 def test_checkpoint_every_s_throttles_block_saves(tmp_path, monkeypatch):
     import gossip_sim_tpu.cli as cli
     from gossip_sim_tpu.checkpoint import load_state

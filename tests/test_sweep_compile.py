@@ -59,10 +59,15 @@ class TestSplit:
         """No EngineParams field may fall through the split: each one must
         land in the static tuple or the knob pytree (a new field that does
         neither would silently stop affecting the compiled engine)."""
+        # derived statics carry no same-named params field: the coarse
+        # impairment gates, and traffic_slots (resolved from
+        # traffic_values + the queue caps, engine/params.py)
         static_fields = set(EngineStatic._fields) - {
-            "has_fail", "has_loss", "has_churn", "has_partition"}
+            "has_fail", "has_loss", "has_churn", "has_partition",
+            "traffic_slots"}
         knob_fields = set(EngineKnobs._fields)
-        assert static_fields | knob_fields == set(EngineParams._fields)
+        assert (static_fields | knob_fields | {"traffic_values"}
+                == set(EngineParams._fields))
         assert not static_fields & knob_fields
 
     def test_knob_dtypes_fixed(self):
@@ -537,6 +542,7 @@ def _assert_collections_equal(serial, lane):
             assert sa[key] == sb[key], f"sim{i}:{key}"
 
 
+@pytest.mark.slow  # tier-1 budget; tools/lane_smoke gate covers this
 def test_lane_sweep_tail_padding_never_leaks():
     """5 sims through 2 lanes = 3 batches, the last one half-padded: the
     padded lane's rows must never reach stats or Influx, every sim's
@@ -552,6 +558,7 @@ def test_lane_sweep_tail_padding_never_leaks():
     assert not any("simulation_iter=5" in ln for ln in lane_pts)
 
 
+@pytest.mark.slow  # tier-1 budget; tools/lane_smoke gate covers this
 def test_lane_sweep_influx_and_stats_parity_churn_and_pull():
     """The acceptance sweeps beyond packet loss: churn and pull-fanout
     lane sweeps produce bit-identical per-sim stats and Influx payloads

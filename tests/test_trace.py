@@ -529,6 +529,7 @@ class TestCliTrace:
             np.testing.assert_array_equal(a.arrays[name], b.arrays[name],
                                           err_msg=name)
 
+    @pytest.mark.slow  # tier-1 budget; tools/trace_smoke gate covers this
     def test_batched_origin_rank_sweep_traces_all_columns(self, tmp_path):
         d = str(tmp_path / "trace")
         rc = self._main(["--iterations", "8", "--warm-up-rounds", "2",
@@ -556,6 +557,7 @@ class TestCliTrace:
         for sub in ("sim000", "sim001"):
             assert validate_trace_dir(os.path.join(d, sub)) == []
 
+    @pytest.mark.slow  # tier-1 budget; tools/trace_smoke gate covers this
     def test_all_origins_traces_sampled_origins(self, tmp_path):
         d = str(tmp_path / "trace")
         rc = self._main(["--iterations", "6", "--warm-up-rounds", "2",

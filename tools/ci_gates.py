@@ -12,6 +12,10 @@ The gates, in dependency-light-first order:
   resume_smoke  resilient execution (ISSUE 7): SIGTERM mid lane sweep ->
                 resumable exit code, bit-exact --resume with zero
                 persistent-cache misses, journal+watchdog overhead < 2%
+  traffic_smoke concurrent traffic (ISSUE 10): M=1/caps-off zero
+                bit-impact, 1k-node engine-vs-TrafficOracle parity under
+                loss+churn+queue caps, per-value coverage monotone in
+                the ingress cap
 
 Usage: python tools/ci_gates.py [--only NAME[,NAME...]]
 
@@ -26,7 +30,7 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
-         "pull_smoke", "lane_smoke", "resume_smoke"]
+         "pull_smoke", "lane_smoke", "resume_smoke", "traffic_smoke"]
 
 
 def main() -> int:

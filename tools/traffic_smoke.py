@@ -1,0 +1,166 @@
+"""Concurrent-traffic smoke test: the traffic subsystem's CI gate
+(traffic.py / engine/traffic.py, ISSUE 10).
+
+Fast CPU gate (~2-3 min) over three contracts:
+
+  1. **M=1 zero bit-impact**: with traffic_values=1 and both queue caps
+     off, a single-origin run through the CLI stats path is bit-identical
+     to the pre-traffic engine — parity snapshot AND deterministic Influx
+     wire lines — even with every *other* traffic knob (rate, stall) set
+     to nonsense: the subsystem must be invisible when off.
+  2. **1k-node oracle parity under caps**: the sort-routed traffic engine
+     and the loop-based TrafficOracle produce bit-identical TrafficStats
+     (per-round counters, retirement records, wire lines) through the full
+     CLI path under packet loss + churn + both queue caps with shared
+     rotation ON.
+  3. **Per-value coverage monotone in the ingress cap**: lifting the
+     ingress budget must never deliver less — total first deliveries and
+     mean per-value coverage are non-decreasing across cap 1 -> 2 ->
+     unlimited (same seed, prune feedback negligible at this scale).
+
+Usage: python tools/traffic_smoke.py [--num-nodes 1000] [--seed 11]
+       [--traffic-values 8] [--iterations 8]
+
+Exit code 0 = all gates hold; 1 = a traffic invariant failed.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="concurrent-traffic subsystem smoke (CPU)")
+    ap.add_argument("--num-nodes", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--traffic-values", type=int, default=8)
+    ap.add_argument("--traffic-rate", type=int, default=2)
+    ap.add_argument("--ingress-cap", type=int, default=24)
+    # low enough that a sender holding most live values (8 values x
+    # fanout 6 = 48 candidates) overflows it — real egress deferral
+    ap.add_argument("--egress-cap", type=int, default=32)
+    ap.add_argument("--packet-loss", type=float, default=0.1)
+    ap.add_argument("--churn-fail", type=float, default=0.02)
+    ap.add_argument("--churn-recover", type=float, default=0.25)
+    ap.add_argument("--iterations", type=int, default=8)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from gossip_sim_tpu.config import Config
+    from gossip_sim_tpu.cli import run_simulation, run_traffic
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    from gossip_sim_tpu.obs import get_registry
+    from gossip_sim_tpu.sinks import DatapointQueue
+    from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+    from gossip_sim_tpu.stats.traffic import TrafficStatsCollection
+
+    t0 = time.time()
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    print(f"traffic smoke: n={args.num_nodes} M={args.traffic_values} "
+          f"rate={args.traffic_rate} caps=({args.ingress_cap},"
+          f"{args.egress_cap}) loss={args.packet_loss} "
+          f"iters={args.iterations}")
+
+    # ---- gate 1: traffic off (M=1, caps 0) has zero bit-impact ----------
+    def run_single(cfg):
+        reset_unique_pubkeys()
+        get_registry().reset()
+        coll = GossipStatsCollection()
+        coll.set_number_of_simulations(1)
+        dpq = DatapointQueue()
+        run_simulation(cfg, "", coll, dpq, 0, "0", 0.0)
+        return (coll.collection[0].parity_snapshot(),
+                dpq.drain_deterministic_lines())
+
+    base = Config(num_synthetic_nodes=200, gossip_iterations=8,
+                  warm_up_rounds=2, seed=args.seed)
+    # inert traffic knobs: traffic stays OFF, so they must not move a bit
+    inert = Config(num_synthetic_nodes=200, gossip_iterations=8,
+                   warm_up_rounds=2, seed=args.seed,
+                   traffic_values=1, node_ingress_cap=0, node_egress_cap=0,
+                   traffic_rate=7, traffic_stall_rounds=99)
+    snap_a, wire_a = run_single(base)
+    snap_b, wire_b = run_single(inert)
+    check(not inert.traffic_on, "traffic_values=1 with caps off keeps the "
+                                "subsystem gated out")
+    check(snap_a == snap_b, "M=1/caps-off run is bit-identical to the "
+                            "pre-traffic engine (stats parity snapshot)")
+    check(wire_a == wire_b, "M=1/caps-off Influx wire lines are "
+                            "bit-identical")
+
+    # ---- gate 2: 1k-node engine-vs-oracle parity through the CLI --------
+    def run_traffic_cfg(cfg):
+        reset_unique_pubkeys()
+        get_registry().reset()
+        coll = TrafficStatsCollection()
+        dpq = DatapointQueue()
+        run_traffic(cfg, "", dpq, "0", collection=coll)
+        return coll.collection, dpq.drain_deterministic_lines()
+
+    tbase = dict(num_synthetic_nodes=args.num_nodes,
+                 traffic_values=args.traffic_values,
+                 traffic_rate=args.traffic_rate,
+                 node_ingress_cap=args.ingress_cap,
+                 node_egress_cap=args.egress_cap,
+                 packet_loss_rate=args.packet_loss,
+                 churn_fail_rate=args.churn_fail,
+                 churn_recover_rate=args.churn_recover,
+                 gossip_iterations=args.iterations, warm_up_rounds=0,
+                 seed=args.seed)
+    coll_t, wire_t = run_traffic_cfg(Config(**tbase))
+    coll_o, wire_o = run_traffic_cfg(Config(backend="oracle", **tbase))
+    sn_t = coll_t[0].parity_snapshot()
+    sn_o = coll_o[0].parity_snapshot()
+    check(sn_t == sn_o,
+          f"engine bit-matches TrafficOracle at n={args.num_nodes}, "
+          f"M={args.traffic_values} under loss+churn+caps "
+          f"(rotation ON)")
+    check(wire_t == wire_o, "both backends emit identical sim_traffic "
+                            "wire payloads")
+    qd = sum(sn_t["rounds"]["queue_dropped"])
+    df = sum(sn_t["rounds"]["deferred"])
+    check(qd > 0 and df > 0,
+          f"the cap regime creates real contention "
+          f"(queue_dropped={qd}, deferred={df})")
+
+    # ---- gate 3: per-value coverage monotone in the ingress cap ---------
+    delivered, coverage = [], []
+    for cap in (1, 2, 0):
+        cfg = Config(**{**tbase, "num_synthetic_nodes": 200,
+                        "node_ingress_cap": cap, "node_egress_cap": 0,
+                        "packet_loss_rate": 0.0, "churn_fail_rate": 0.0,
+                        "churn_recover_rate": 0.0,
+                        "gossip_iterations": 10})
+        coll, _ = run_traffic_cfg(cfg)
+        s = coll[0]
+        delivered.append(sum(s.rounds["delivered"]))
+        summ = s.summary()
+        coverage.append((summ["value_coverage_mean"], summ["values_retired"]))
+    print(f"  ingress cap 1 -> 2 -> off: delivered={delivered} "
+          f"(coverage_mean, retired)={coverage}")
+    check(delivered[0] <= delivered[1] <= delivered[2],
+          f"first deliveries monotone in ingress cap {delivered}")
+
+    dt = time.time() - t0
+    print(f"  elapsed: {dt:.1f}s")
+    if failures:
+        print(f"TRAFFIC SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("TRAFFIC SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
