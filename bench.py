@@ -230,6 +230,31 @@ def worker(args) -> int:
                              / (tn * max(traffic_retired, 1)))
                        if traffic_retired else 0.0)
 
+    # ---- adaptive traffic rung: the same starved workload healed by the
+    # direction-optimizing switch (adaptive.py, ISSUE 11).  Identical
+    # config + seed as the traffic rung with --gossip-mode adaptive, so
+    # the values_converged / values_rescued deltas vs push are the
+    # robustness number: BENCH_r07's push arm converges 0 of 80 values at
+    # ~98.7% coverage; the per-value pull-rescue phase finishes them.
+    aparams = tparams._replace(gossip_mode="adaptive")
+    astate = init_traffic_state(tstakes, aparams, seed=0)
+    t_ac = time.perf_counter()
+    astate, arows = run_traffic_rounds(aparams, ttables_c, tt, astate, 3)
+    jax.block_until_ready(arows["converged"])
+    adaptive_compile_dt = time.perf_counter() - t_ac
+    t_ar = time.perf_counter()
+    astate, arows = run_traffic_rounds(aparams, ttables_c, tt, astate,
+                                       titers, start_it=3)
+    jax.block_until_ready(arows["converged"])
+    adaptive_dt = time.perf_counter() - t_ar
+    a_conv = int(np.asarray(arows["converged"]).sum())
+    a_ret = int(np.asarray(arows["retired"]).sum())
+    _am = np.asarray(arows["ret_mask"])
+    a_nodes_rescued = int(np.asarray(arows["ret_rescued"])[_am].sum())
+    a_vals_rescued = int(np.count_nonzero(
+        np.asarray(arows["ret_rescued"])[_am]
+        * np.asarray(arows["ret_full"])[_am]))
+
     result = bench_summary(
         reg, platform=platform, num_nodes=n, origin_batch=o,
         iterations=args.iterations,
@@ -276,6 +301,31 @@ def worker(args) -> int:
         "injected": int(np.asarray(trows["injected"]).sum()),
         "queue_dropped": int(np.asarray(trows["queue_dropped"]).sum()),
         "deferred": int(np.asarray(trows["deferred"]).sum()),
+    }
+    result["adaptive_traffic_steps_per_sec"] = round(
+        titers / adaptive_dt, 2) if adaptive_dt > 0 else 0.0
+    result["adaptive_traffic"] = {
+        "gossip_mode": "adaptive",
+        "adaptive_switch_threshold": aparams.adaptive_switch_threshold,
+        "adaptive_switch_hysteresis": aparams.adaptive_switch_hysteresis,
+        "timed_rounds": titers,
+        "warm_elapsed_s": round(adaptive_dt, 3),
+        "first_call_elapsed_s": round(adaptive_compile_dt, 3),
+        "values_converged": a_conv,
+        "values_retired": a_ret,
+        "values_rescued": a_vals_rescued,
+        "nodes_rescued": a_nodes_rescued,
+        "switched_to_pull": int(np.asarray(
+            arows["switched_to_pull"]).sum()),
+        "pull_sent": int(np.asarray(arows["pull_sent"]).sum()),
+        "pull_responses": int(np.asarray(arows["pull_responses"]).sum()),
+        "queue_dropped": int(np.asarray(arows["queue_dropped"]).sum()),
+        # the robustness deltas vs the push arm above (same config+seed)
+        "delta_vs_push": {
+            "values_converged": a_conv - traffic_converged,
+            "values_rescued": a_vals_rescued,
+            "values_retired": a_ret - traffic_retired,
+        },
     }
     pc = persistent_cache_counters()
     result["compilation_cache"] = {

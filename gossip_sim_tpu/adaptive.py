@@ -1,0 +1,168 @@
+"""Adaptive push-pull: direction-optimizing gossip, shared by both backends.
+
+Push gossip is cheap while the infected set is small and ruinously
+redundant once it is large: with coverage c, a push round moves ~c*N*fanout
+messages to deliver ~(1-c)*N new values, so the marginal cost per delivery
+explodes exactly when the value is almost everywhere.  Pull has the mirror
+profile — each *missing* node asks a few peers, so its cost scales with
+(1-c)*N and its hit rate with c.  "Implementing Push-Pull Efficiently in
+GraphBLAS" (PAPERS.md) turns this into the direction-optimizing rule this
+module implements: **push while coverage is low, flip to pull once
+coverage crosses a threshold**.
+
+``gossip_mode="adaptive"`` applies the rule in both engines:
+
+* **Single-origin engine** (engine/core.py): the pull (anti-entropy) phase
+  of ``pull.py`` is gated per origin-sim on a carried boolean
+  (``SimState.adaptive_pull_on``).  Each round the switch re-evaluates on
+  the round's *push* coverage: the pull phase activates for the NEXT round
+  once ``n_reached >= threshold * N`` and deactivates once coverage falls
+  below ``(threshold - hysteresis) * N`` (coverage is re-derived per round
+  in this model, so churn/loss can drop it back under the bar; the
+  hysteresis window stops the direction bit from thrashing at the
+  boundary).  The push phase always runs — in the memoryless
+  BFS-per-round model it *is* the value's presence — so "flip to pull"
+  means "start paying for the reverse direction only when it can do
+  last-mile work", which is where all of pull's rescue value and almost
+  none of its cost lives (vs ``push-pull``, which pays pull every round).
+* **Traffic engine** (engine/traffic.py): the switch is per *value*.  A
+  value whose coverage crosses the threshold stops generating push
+  candidates (freeing its share of every sender's egress budget — the
+  direction flip is a real bandwidth reallocation under queue caps) and
+  enters its **pull-rescue phase**: every live node still missing the
+  value sends ``pull_fanout`` stake-weighted pull requests for it.
+  Requests ride the SAME per-node egress/ingress queue budgets as push
+  traffic (ranked after the round's push messages, in value-major order),
+  so rescues compete for bandwidth honestly; a holder answers an accepted
+  request unless the requester's bloom digest false-positives the value
+  away.  Rescue deliveries are tagged per value (``rescued_by_pull`` in
+  the retirement record) — the measurable fix for BENCH_r07's
+  queue-drop starvation, where push alone converges 0 of 80 values.
+
+Switch decision (bit-exact by construction in both backends): integer
+coverage counts compared against f64 products, with one shared
+formulation (:func:`switch_update_arr`):
+
+    up   = float64(n_covered) >= threshold * N
+    down = float64(n_covered) <  (threshold - hysteresis) * N
+    on'  = up ? True : (down ? False : on)
+
+Both knobs are traced :class:`EngineKnobs` leaves, so a threshold sweep
+compiles once and runs lane-batched.
+
+Determinism contract for the traffic pull-rescue (the faults.py
+philosophy): every stochastic choice is a stateless counter hash,
+decorrelated per value through ``traffic.value_basis`` so two values in
+their pull phase draw independent peers/loss/bloom coins:
+
+    peer draw   class/member u01 of edge-hash(value_basis(b, vid), node, slot)
+    request loss edge-hash(value_basis(b, vid), requester, peer) < rate * 2^32
+    bloom FP    node-hash(value_basis(b, vid), requester)        < rate * 2^32
+
+``TrafficOracle`` (traffic.py) and the sort-routed traffic engine consume
+these through the same ``*_arr`` helpers, so the 1k-node parity tests hold
+bit-for-bit under loss + churn with the switch active.
+
+Everything here is numpy-only: importing this module never touches JAX.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .pull import PullOracle, PullRound
+
+# domain-separation salts for the traffic pull-rescue hash streams
+# (faults.py convention; SHA-256 round constants, distinct from every
+# existing SALT_* in faults.py / pull.py / traffic.py)
+SALT_ADAPT_PCLASS = 0x59F111F1   # rescue peer draw: stake-class uniform
+SALT_ADAPT_PMEMBER = 0x923F82A4  # rescue peer draw: within-class uniform
+SALT_ADAPT_PLOSS = 0xAB1C5ED5    # per-(value, requester, peer) request loss
+SALT_ADAPT_PBLOOM = 0xD807AA98   # per-(value, requester) bloom-FP event
+
+
+def switch_update_arr(n_covered, num_nodes, prev_on, threshold, hysteresis,
+                      xp=np):
+    """The direction switch, one formulation for both backends.
+
+    ``n_covered``: integer coverage count(s) (any shape); ``prev_on``:
+    matching bool(s).  ``threshold``/``hysteresis`` are f64 scalars (traced
+    on the engine side).  All arithmetic is f64 with one fixed operation
+    order — integer count widened to f64, thresholds multiplied against
+    f64(N) — so numpy (oracle) and jax.numpy (engine) lanes agree
+    bit-for-bit."""
+    cov = xp.asarray(n_covered).astype(xp.float64)
+    n = xp.asarray(num_nodes).astype(xp.float64)
+    thr = xp.asarray(threshold).astype(xp.float64)
+    hyst = xp.asarray(hysteresis).astype(xp.float64)
+    up = cov >= thr * n
+    down = cov < (thr - hyst) * n
+    return xp.where(up, True, xp.where(down, False, prev_on))
+
+
+def switch_update(n_covered: int, num_nodes: int, prev_on: bool,
+                  threshold: float, hysteresis: float) -> bool:
+    """Scalar twin of :func:`switch_update_arr` (oracle loops)."""
+    return bool(switch_update_arr(np.int64(n_covered), np.int64(num_nodes),
+                                  np.bool_(prev_on), threshold, hysteresis))
+
+
+def empty_pull_round(num_nodes: int, pull_slots: int) -> PullRound:
+    """The all-zero PullRound an inactive pull round reports — identical
+    to what ``PullOracle.run_round`` returns off its interval, so a
+    switch-gated round and an interval-gated round are indistinguishable
+    downstream (exactly like the engine, whose gated pull block emits
+    zero counts and -1 peer slots)."""
+    n, ps = int(num_nodes), int(pull_slots)
+    return PullRound(0, 0, 0, 0, 0, {}, np.zeros(n, np.int64),
+                     np.zeros(n, np.int64), np.full((n, ps), -1, np.int16),
+                     np.zeros((n, ps), np.int8), np.full(n, -1, np.int16))
+
+
+class AdaptiveOracle:
+    """CPU-oracle adaptive direction switch for the single-origin path.
+
+    Wraps a :class:`pull.PullOracle` behind the carried ``pull_active``
+    bit and re-evaluates the switch each round on the round's push
+    coverage — the identical spec the engine's ``round/pull`` gating +
+    end-of-round ``switch_update_arr`` implement, so the 1k-node parity
+    test (tests/test_adaptive.py / tools/adaptive_smoke.py) checks the
+    sort-routed engine against this class bit-for-bit under loss + churn.
+
+    Drop-in for ``PullOracle`` in ``oracle/cluster.run_pull``: a round
+    where the switch (or the inner pull interval) is off returns the same
+    empty :class:`PullRound` an off-interval ``PullOracle`` round does.
+    ``switch_rounds`` records every flip as ``(iteration, new_state)`` —
+    the oracle twin of the engine's ``adaptive_switched`` row.
+    """
+
+    def __init__(self, stakes, *, adaptive_switch_threshold: float = 0.9,
+                 adaptive_switch_hysteresis: float = 0.05, **pull_kwargs):
+        self.inner = PullOracle(stakes, **pull_kwargs)
+        self.n = self.inner.n
+        self.pull_slots = self.inner.pull_slots
+        self.threshold = float(adaptive_switch_threshold)
+        self.hysteresis = float(adaptive_switch_hysteresis)
+        self.pull_active = False
+        self.switch_rounds = []   # [(iteration, now_on)] flip history
+
+    def pull_round_active(self, it: int) -> bool:
+        """Whether this round's pull exchange will actually run."""
+        return self.pull_active and self.inner.pull_round_active(it)
+
+    def run_round(self, it: int, hops, failed) -> PullRound:
+        """One adaptive round: run (or gate) the pull exchange against
+        this round's push outcome, then update the direction bit from the
+        push coverage for the next round."""
+        hops = np.asarray(hops)
+        if self.pull_active:
+            res = self.inner.run_round(it, hops, failed)
+        else:
+            res = empty_pull_round(self.n, self.pull_slots)
+        n_reached = int(np.count_nonzero(hops >= 0))
+        new_on = switch_update(n_reached, self.n, self.pull_active,
+                               self.threshold, self.hysteresis)
+        if new_on != self.pull_active:
+            self.switch_rounds.append((int(it), bool(new_on)))
+        self.pull_active = new_on
+        return res

@@ -22,7 +22,7 @@ from .engine.params import EngineParams
 
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 6
+_FORMAT_VERSION = 7
 # v1 checkpoints predate the tfail/rc_shi/rc_slo SimState fields; all three
 # are derivable from active/failed/rc_src plus the cluster stake table, so
 # v1 files remain loadable when ``tables`` is passed to restore_sim_state.
@@ -47,10 +47,17 @@ _FORMAT_VERSION = 6
 # (shared active set, M value slots, queue accumulators) and the
 # serialized TrafficStats, written/read by save_traffic_state /
 # restore_traffic_state.  Pre-v6 files backfill an all-off traffic block
-# and kind "sim"; the committed v1-v5 fixtures in
+# and kind "sim".  v7 adds the adaptive push-pull subsystem
+# (adaptive.py): an ``adaptive`` meta block (switch threshold/hysteresis
+# knobs), the SimState ``adaptive_pull_on`` direction bit, and the
+# TrafficState ``v_pull``/``v_rescued``/``v_qdrop`` per-value arrays.
+# Pre-v7 files were written by engines whose direction bit was
+# identically False and whose rescue/qdrop counters never existed, so all
+# four arrays backfill as zeros (exact) and the adaptive block as the
+# engine defaults.  The committed v1-v6 fixtures in
 # tests/fixtures/checkpoints pin that forward-compat contract forever
 # (tests/test_checkpoint.py).
-_READABLE_VERSIONS = (1, 2, 3, 4, 5, 6)
+_READABLE_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
 
 # EngineParams fields that define array shapes; a mismatch makes the stored
 # state unusable under the new compile geometry.
@@ -86,6 +93,14 @@ _TRAFFIC_DEFAULTS = {f: EngineParams._field_defaults[f]
 _TRAFFIC_SHAPE_FIELDS = ("num_nodes", "active_set_size", "rc_slots",
                          "traffic_values")
 
+# EngineParams fields describing the adaptive push-pull schedule (v7 meta
+# block); same contract as impair/pull/traffic — knobs + state fully
+# determine a bit-exact continuation (the direction bit is carried state,
+# every rescue decision a stateless counter hash, adaptive.py).
+_ADAPTIVE_FIELDS = ("adaptive_switch_threshold", "adaptive_switch_hysteresis")
+_ADAPTIVE_DEFAULTS = {f: EngineParams._field_defaults[f]
+                      for f in _ADAPTIVE_FIELDS}
+
 
 def save_state(path: str, state, params, config=None,
                iteration: int = 0, resilience: dict | None = None,
@@ -111,6 +126,9 @@ def save_state(path: str, state, params, config=None,
         # v6: the concurrent-traffic schedule (all-off on plain sims)
         "traffic": {f: pdict.get(f, _TRAFFIC_DEFAULTS[f])
                     for f in _TRAFFIC_FIELDS},
+        # v7: the adaptive push-pull switch knobs (adaptive.py)
+        "adaptive": {f: pdict.get(f, _ADAPTIVE_DEFAULTS[f])
+                     for f in _ADAPTIVE_FIELDS},
         "iteration": int(iteration),
         # v5: journal cross-reference (resilience.py) — {} for plain
         # single-run checkpoints with no journal alongside
@@ -168,6 +186,7 @@ def load_state(path: str, params=None, expect_kind=None):
     meta.setdefault("pull", dict(_PULL_DEFAULTS))
     meta.setdefault("resilience", {})
     meta.setdefault("traffic", dict(_TRAFFIC_DEFAULTS))
+    meta.setdefault("adaptive", dict(_ADAPTIVE_DEFAULTS))
     meta.setdefault("kind", "sim")
     if expect_kind is not None and meta["kind"] != expect_kind:
         hint = ("restore_traffic_state / the --traffic-values run path"
@@ -207,6 +226,15 @@ def load_state(path: str, params=None, expect_kind=None):
                     "from the original run",
                     f, getattr(params, f, _TRAFFIC_DEFAULTS[f]),
                     meta["traffic"][f])
+        for f in _ADAPTIVE_FIELDS:
+            if (getattr(params, f, _ADAPTIVE_DEFAULTS[f])
+                    != meta["adaptive"][f]):
+                log.warning(
+                    "WARNING: resuming with %s=%s but checkpoint was written "
+                    "with %s — the continuation's adaptive switch schedule "
+                    "diverges from the original run",
+                    f, getattr(params, f, _ADAPTIVE_DEFAULTS[f]),
+                    meta["adaptive"][f])
     return arrays, stored, meta
 
 
@@ -233,6 +261,12 @@ def restore_sim_state(path: str, params=None, tables=None):
             arrays["pull_hops_hist_acc"] = np.zeros((o, h), np.int32)
         if "pull_rescued_acc" in missing:
             arrays["pull_rescued_acc"] = np.zeros((o, n), np.int32)
+        missing = set(SimState._fields) - set(arrays)
+    if "adaptive_pull_on" in missing:
+        # pre-v7 files were written by engines whose direction bit was
+        # identically False (no adaptive mode existed) — zeros are exact
+        arrays["adaptive_pull_on"] = np.zeros(
+            (arrays["failed"].shape[0],), bool)
         missing = set(SimState._fields) - set(arrays)
     derivable = {"tfail", "rc_shi", "rc_slo"}
     if missing and missing <= derivable and tables is not None:
@@ -279,6 +313,19 @@ def restore_traffic_state(path: str, params=None):
 
     arrays, stored, meta = load_state(path, params, expect_kind="traffic")
     missing = set(TrafficState._fields) - set(arrays)
+    adaptive_fields = {"v_pull", "v_rescued", "v_qdrop"}
+    if missing & adaptive_fields:
+        # pre-v7 traffic checkpoints: the adaptive direction bits and
+        # rescue/qdrop counters did not exist — zeros are exact (no pull
+        # phase ever ran, no per-value drop attribution was recorded)
+        v = arrays["v_live"].shape[0]
+        if "v_pull" in missing:
+            arrays["v_pull"] = np.zeros((v,), bool)
+        if "v_rescued" in missing:
+            arrays["v_rescued"] = np.zeros((v,), np.int32)
+        if "v_qdrop" in missing:
+            arrays["v_qdrop"] = np.zeros((v,), np.int32)
+        missing = set(TrafficState._fields) - set(arrays)
     if missing:
         raise ValueError(f"traffic checkpoint missing fields: "
                          f"{sorted(missing)}")

@@ -152,12 +152,15 @@ def _impair_params(config) -> dict:
 
 
 def _pull_params(config) -> dict:
-    """EngineParams kwargs for the pull-gossip knobs (pull.py)."""
+    """EngineParams kwargs for the pull-gossip knobs (pull.py) and the
+    adaptive direction-switch knobs (adaptive.py)."""
     return dict(gossip_mode=config.gossip_mode,
                 pull_fanout=config.pull_fanout,
                 pull_interval=config.pull_interval,
                 pull_bloom_fp_rate=config.pull_bloom_fp_rate,
-                pull_request_cap=config.pull_request_cap)
+                pull_request_cap=config.pull_request_cap,
+                adaptive_switch_threshold=config.adaptive_switch_threshold,
+                adaptive_switch_hysteresis=config.adaptive_switch_hysteresis)
 
 
 def _traffic_params(config) -> dict:
@@ -196,17 +199,29 @@ def _engine_params(config, num_nodes: int):
 
 
 def _make_pull_oracle(config, index):
-    """Oracle-side pull driver (pull.PullOracle), or None for push mode."""
+    """Oracle-side pull driver (pull.PullOracle), or None for push mode.
+    Mode "adaptive" wraps it in the direction-switch gate
+    (adaptive.AdaptiveOracle — a drop-in whose gated rounds report the
+    same empty PullRound an off-interval round does)."""
     if not config.has_pull:
         return None
-    from .pull import PullOracle
-    return PullOracle(
-        index.stakes.astype(np.int64), seed=config.seed,
+    kwargs = dict(
+        seed=config.seed,
         pull_fanout=config.pull_fanout, pull_interval=config.pull_interval,
         pull_bloom_fp_rate=config.pull_bloom_fp_rate,
         pull_request_cap=config.pull_request_cap,
         packet_loss_rate=config.packet_loss_rate,
         partition_at=config.partition_at, heal_at=config.heal_at)
+    stakes = index.stakes.astype(np.int64)
+    if config.gossip_mode == "adaptive":
+        from .adaptive import AdaptiveOracle
+        return AdaptiveOracle(
+            stakes,
+            adaptive_switch_threshold=config.adaptive_switch_threshold,
+            adaptive_switch_hysteresis=config.adaptive_switch_hysteresis,
+            **kwargs)
+    from .pull import PullOracle
+    return PullOracle(stakes, **kwargs)
 
 
 def _make_trace_writer(config, index, origin_indices, *, backend,
@@ -317,12 +332,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="iteration at which the partition heals (-1 = never)")
     # ---- pull gossip / anti-entropy (pull.py) ---------------------------
     p.add_argument("--gossip-mode", default="push",
-                   choices=["push", "pull", "push-pull"],
+                   choices=["push", "pull", "push-pull", "adaptive"],
                    help="protocol phases to simulate: push (the reference "
                         "protocol; default, bit-identical to the push-only "
-                        "simulator), pull (anti-entropy only), or "
+                        "simulator), pull (anti-entropy only), "
                         "push-pull (both; pull rescues push-stranded "
-                        "nodes)")
+                        "nodes), or adaptive (direction-optimizing: push "
+                        "while coverage is low, the pull phase activates "
+                        "once it crosses --adaptive-switch-threshold; in "
+                        "traffic mode the switch is per value and "
+                        "pull-rescues heal queue-drop starvation, "
+                        "adaptive.py)")
+    p.add_argument("--adaptive-switch-threshold", type=float, default=0.9,
+                   help="adaptive mode: coverage fraction at which a "
+                        "sim/value flips from push into its pull phase "
+                        "(traced knob — threshold sweeps compile once)")
+    p.add_argument("--adaptive-switch-hysteresis", type=float, default=0.05,
+                   help="adaptive mode: the direction bit flips back to "
+                        "push only when coverage falls below threshold - "
+                        "hysteresis (stops boundary thrash)")
     p.add_argument("--pull-fanout", type=int, default=2,
                    help="pull requests each live node sends per pull round "
                         "(stake-weighted peer sampling)")
@@ -496,6 +524,13 @@ def config_from_args(args) -> Config:
             raise SystemExit("pull-fanout must be >= 1")
         if args.pull_interval < 1:
             raise SystemExit("pull-interval must be >= 1")
+    if args.gossip_mode == "adaptive":
+        if not 0.0 < args.adaptive_switch_threshold <= 1.0:
+            raise SystemExit("adaptive-switch-threshold must be in (0, 1]")
+        if not (0.0 <= args.adaptive_switch_hysteresis
+                < args.adaptive_switch_threshold):
+            raise SystemExit("adaptive-switch-hysteresis must be in "
+                             "[0, adaptive-switch-threshold)")
     if args.mesh_node_shards < 1:
         raise SystemExit("mesh-node-shards must be >= 1")
     if args.sweep_lanes < 0:
@@ -526,6 +561,8 @@ def config_from_args(args) -> Config:
         pull_interval=args.pull_interval,
         pull_bloom_fp_rate=args.pull_bloom_fp_rate,
         pull_request_cap=args.pull_request_cap,
+        adaptive_switch_threshold=args.adaptive_switch_threshold,
+        adaptive_switch_hysteresis=args.adaptive_switch_hysteresis,
         traffic_values=args.traffic_values,
         traffic_rate=args.traffic_rate,
         node_ingress_cap=args.node_ingress_cap,
@@ -704,7 +741,15 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
             # about to push through (the engine captures the same instant)
             collector.begin_round(cluster, node_map)
         cluster.run_gossip(origin_pubkey, stakes, node_map, impair)
+        adaptive_pre = None
         if pull_oracle is not None:
+            # adaptive mode: capture the direction bit in effect BEFORE
+            # run_round's end-of-round switch update (the engine's
+            # adaptive_pull_active row)
+            if config.gossip_mode == "adaptive":
+                adaptive_pre = bool(pull_oracle.pull_active)
+                if collector is not None:
+                    collector.adaptive_on = adaptive_pre
             # anti-entropy exchange against this round's push outcome
             cluster.run_pull(pull_oracle, it, index, node_map)
         cluster.consume_messages(origin_pubkey, nodes)
@@ -765,6 +810,10 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
                 stats.insert_pull(pr.requests, pr.responses, pr.misses,
                                   pr.dropped, pr.suppressed,
                                   len(pr.rescued))
+            if adaptive_pre is not None:
+                sw = pull_oracle.switch_rounds
+                stats.insert_adaptive(
+                    adaptive_pre, int(bool(sw) and sw[-1][0] == it))
             _push_iteration_points(config, dp_queue, sim_iter, start_ts,
                                    stats, steady, coverage, rmr_result)
             reg.record("stats/harvest", time.perf_counter() - t_h)
@@ -1027,6 +1076,9 @@ def _feed_measured_round(stats, rows, t, col, it, config, index, stakes,
                           int(rows["pull_dropped"][t, col]),
                           int(rows["pull_suppressed"][t, col]),
                           int(rows["pull_rescued"][t, col]))
+    if "adaptive_pull_active" in rows:
+        stats.insert_adaptive(int(rows["adaptive_pull_active"][t, col]),
+                              int(rows["adaptive_switched"][t, col]))
     _push_iteration_points(config, dp_queue, sim_iter, start_ts,
                            stats, steady, coverage, rmr_result)
 
@@ -2002,6 +2054,10 @@ def _push_iteration_points(config, dp_queue, sim_iter, start_ts, stats,
             int(stats.pull_dropped_stats.collection[-1]),
             int(stats.pull_suppressed_stats.collection[-1]),
             int(stats.pull_rescued_stats.collection[-1]))
+    if stats.has_adaptive_stats():
+        dp.create_sim_adaptive_point(steady, {
+            "active": stats.adaptive_active_series[-1],
+            "switched": stats.adaptive_switched_series[-1]})
     dp.create_iteration_point(steady, sim_iter)
     dp_queue.push_back(dp)
 
@@ -2349,6 +2405,16 @@ def _collection_summaries(collection):
             "rescued": int(sum(sum(s.pull_rescued_stats.collection)
                                for s in pulls)),
         }
+    adapt = [s for s in sims if s.has_adaptive_stats()]
+    if adapt:
+        # run-report adaptive section (single-origin path): rounds the
+        # direction bit was on + the switch events it took to get there
+        stats["adaptive"] = {
+            "pull_active_rounds": int(sum(sum(s.adaptive_active_series)
+                                          for s in adapt)),
+            "switch_events": int(sum(sum(s.adaptive_switched_series)
+                                     for s in adapt)),
+        }
     return stats, faults
 
 
@@ -2372,10 +2438,11 @@ def _write_run_report(config, stats=None, faults=None, influx=None):
 # concurrent-traffic runs (traffic.py / engine/traffic.py — ISSUE 10)
 # --------------------------------------------------------------------------
 
-#: test types a traffic run can sweep; all four step traced EngineKnobs
+#: test types a traffic run can sweep; all five step traced EngineKnobs
 #: leaves, so every traffic sweep compiles once and is lane-eligible
 TRAFFIC_SWEEP_TYPES = (Testing.TRAFFIC_RATE, Testing.NODE_INGRESS_CAP,
-                       Testing.PACKET_LOSS, Testing.CHURN)
+                       Testing.PACKET_LOSS, Testing.CHURN,
+                       Testing.ADAPTIVE_THRESHOLD)
 
 
 def _push_sim_traffic_point(config, dp_queue, sim_iter, start_ts, it, vals):
@@ -2392,6 +2459,16 @@ def _push_sim_traffic_summary_point(dp_queue, sim_iter, start_ts, summary):
         return
     dp = InfluxDataPoint(start_ts, sim_iter)
     dp.create_sim_traffic_summary_point(summary)
+    dp_queue.push_back(dp)
+
+
+def _push_sim_adaptive_point(dp_queue, sim_iter, start_ts, it, vals):
+    """One sim_adaptive point per measured round (adaptive traffic mode:
+    the ADAPTIVE_ROUND_FIELDS pull-rescue counters)."""
+    if dp_queue is None:
+        return
+    dp = InfluxDataPoint(start_ts, sim_iter)
+    dp.create_sim_adaptive_point(it, vals)
     dp_queue.push_back(dp)
 
 
@@ -2419,19 +2496,29 @@ def _traffic_oracle(config, params, stakes_np):
         packet_loss_rate=params.packet_loss_rate,
         churn_fail_rate=params.churn_fail_rate,
         churn_recover_rate=params.churn_recover_rate,
-        partition_at=params.partition_at, heal_at=params.heal_at)
+        partition_at=params.partition_at, heal_at=params.heal_at,
+        gossip_mode=params.gossip_mode,
+        adaptive_switch_threshold=params.adaptive_switch_threshold,
+        adaptive_switch_hysteresis=params.adaptive_switch_hysteresis,
+        pull_fanout=params.pull_fanout,
+        pull_slots=(params.pull_slots_resolved if params.has_pull else 0),
+        pull_bloom_fp_rate=params.pull_bloom_fp_rate)
 
 
 def _feed_traffic_rows(stats, config, dp_queue, sim_iter, start_ts, rows,
                        start_it, n_it, num_nodes, lane=None):
     """Harvested traffic rows -> TrafficStats + sim_traffic Influx points
     (measured rounds only; the warm-up scan discards its rows)."""
-    from .stats.traffic import ROUND_FIELDS
+    from .stats.traffic import ADAPTIVE_ROUND_FIELDS, ROUND_FIELDS
     from .traffic import retire_record
     sel = (lambda arr, t: arr[t] if lane is None else arr[t, lane])
+    adaptive = "pull_sent" in rows
     for t in range(n_it):
         it = start_it + t
         vals = {k: int(sel(rows[k], t)) for k in ROUND_FIELDS}
+        if adaptive:
+            vals.update({k: int(sel(rows[k], t))
+                         for k in ADAPTIVE_ROUND_FIELDS})
         stats.feed_round(it, vals)
         recs = []
         ret = np.asarray(sel(rows["ret_mask"], t))
@@ -2440,7 +2527,8 @@ def _feed_traffic_rows(stats, config, dp_queue, sim_iter, start_ts, rows,
             recs.append(retire_record(
                 int(g("ret_vid")), int(g("ret_origin")), int(g("ret_birth")),
                 it, int(g("ret_holders")), num_nodes, int(g("ret_m")),
-                bool(g("ret_full")), int(g("ret_hops_sum"))))
+                bool(g("ret_full")), int(g("ret_hops_sum")),
+                rescued=int(g("ret_rescued")), qdrops=int(g("ret_qdrop"))))
         if recs:
             stats.feed_records(recs)
         if it % 10 == 0:
@@ -2448,6 +2536,10 @@ def _feed_traffic_rows(stats, config, dp_queue, sim_iter, start_ts, rows,
                      vals["live"], vals["retired"])
         _push_sim_traffic_point(config, dp_queue, sim_iter, start_ts, it,
                                 vals)
+        if adaptive:
+            _push_sim_adaptive_point(
+                dp_queue, sim_iter, start_ts, it,
+                {k: vals[k] for k in ADAPTIVE_ROUND_FIELDS})
 
 
 def _traffic_final_from_state(state) -> dict:
@@ -2484,6 +2576,9 @@ def _run_traffic_oracle_point(config, params, stakes_np, stats, dp_queue,
     totals = {k: 0 for k in ("injected", "inject_dropped", "retired",
                              "converged", "deferred", "queue_dropped",
                              "sent", "recv", "prunes")}
+    adaptive = config.gossip_mode == "adaptive"
+    if adaptive:
+        from .stats.traffic import ADAPTIVE_ROUND_FIELDS
     hb = Heartbeat(config.gossip_iterations, label="traffic rounds",
                    unit="iter")
     for it in range(config.gossip_iterations):
@@ -2497,19 +2592,31 @@ def _run_traffic_oracle_point(config, params, stakes_np, stats, dp_queue,
                      "arrived", "queue_dropped", "accepted", "delivered",
                      "redundant", "prunes_sent", "retired", "converged",
                      "hop_clamped", "qdepth_max", "inflow_max")}
+            if adaptive:
+                vals.update({k: getattr(tr, k)
+                             for k in ADAPTIVE_ROUND_FIELDS})
             stats.feed_round(it, vals)
             stats.feed_records(tr.records)
             totals["injected"] += tr.injected
             totals["inject_dropped"] += tr.inject_dropped
             totals["retired"] += tr.retired
             totals["converged"] += tr.converged
-            totals["deferred"] += tr.deferred
-            totals["queue_dropped"] += tr.queue_dropped
-            totals["sent"] += tr.sends
-            totals["recv"] += tr.accepted
+            # pull-rescue traffic joins the same totals the engine's node
+            # accumulators sum (requests: requester egress + peer ingress;
+            # responses: peer egress + requester ingress)
+            totals["deferred"] += tr.deferred + tr.pull_deferred
+            totals["queue_dropped"] += (tr.queue_dropped
+                                        + tr.pull_queue_dropped)
+            totals["sent"] += tr.sends + tr.pull_sent + tr.pull_responses
+            totals["recv"] += (tr.accepted + tr.pull_served
+                               + tr.pull_responses)
             totals["prunes"] += tr.prunes_sent
             _push_sim_traffic_point(config, dp_queue, sim_iter, start_ts,
                                     it, vals)
+            if adaptive:
+                _push_sim_adaptive_point(
+                    dp_queue, sim_iter, start_ts, it,
+                    {k: vals[k] for k in ADAPTIVE_ROUND_FIELDS})
         if it % 10 == 0:
             hb.beat(it)
     live = sum(sl is not None for sl in oracle.slots)
@@ -2548,6 +2655,9 @@ def _run_traffic_tpu_point(config, params, stakes_np, index, stats,
                 active_set_size=params.active_set_size,
                 prune_cap=params.split()[0].traffic_prune_cap,
                 traffic_slots=params.traffic_values,
+                gossip_mode=params.gossip_mode,
+                pull_slots=(params.pull_slots_resolved
+                            if params.has_pull else 0),
                 origins=[], origin_pubkeys=[], seed=config.seed,
                 warm_up_rounds=config.warm_up_rounds,
                 iterations=config.gossip_iterations, config=config)
@@ -2662,16 +2772,26 @@ def _log_traffic_summary(label, s):
     as lossless)."""
     log.info(
         "TRAFFIC SUMMARY%s: %s values injected (%s dropped at injection), "
-        "%s retired (%s converged, %s stranded, %s unfinished) | "
+        "%s retired (%s converged [%s by pull rescue], %s stranded "
+        "[%s starved by queue drops], %s unfinished) | "
         "coverage mean %.4f | latency mean %.2f p90 %.2f rounds | "
         "value RMR mean %.3f | queue: %s deferred (max depth %s), "
         "%s dropped | loss %s, hop_clamped %s",
         label, s["values_injected"], s["inject_dropped"],
-        s["values_retired"], s["values_converged"], s["values_stranded"],
+        s["values_retired"], s["values_converged"], s["values_rescued"],
+        s["values_stranded"], s["values_starved_queue_drop"],
         s["values_unfinished"], s["value_coverage_mean"],
         s["value_latency_mean"], s["value_latency_p90"],
         s["value_rmr_mean"], s["queue_deferred"], s["qdepth_max"],
         s["queue_dropped"], s["loss_dropped"], s["hop_clamped"])
+    if "adaptive_pull_sent" in s:
+        log.info(
+            "ADAPTIVE SUMMARY%s: %s values switched to pull | rescue "
+            "requests %s sent (%s deferred, %s queue-dropped), %s "
+            "responses, %s nodes rescued",
+            label, s["adaptive_switched_to_pull"], s["adaptive_pull_sent"],
+            s["adaptive_pull_deferred"], s["adaptive_pull_queue_dropped"],
+            s["adaptive_pull_responses"], s["adaptive_pull_rescued"])
 
 
 def _traffic_lane_blocker(config: Config, n_points: int):
@@ -2857,6 +2977,8 @@ def run_traffic(config: Config, json_rpc_url: str, dp_queue, start_ts: str,
             agg.iterations.extend(st.iterations)
             for k in agg.rounds:
                 agg.rounds[k].extend(st.rounds[k])
+            for k in agg.adaptive_rounds:
+                agg.adaptive_rounds[k].extend(st.adaptive_rounds[k])
             agg.records.extend(st.records)
         agg.final = {"live_at_end": sum(
             int(st.final.get("live_at_end", 0))
@@ -2865,12 +2987,31 @@ def run_traffic(config: Config, json_rpc_url: str, dp_queue, start_ts: str,
     else:
         out = dict(summaries[-1]) if summaries else {}
         out.pop("point", None)
-    return {
+    report = {
         "traffic": out,
         "traffic_points": summaries if n_points > 1 else [],
         "num_points": n_points,
         "sweep_lanes": config.sweep_lanes if lane_mode else 0,
     }
+    if config.gossip_mode == "adaptive":
+        # run-report adaptive section: the switch configuration plus the
+        # pull-rescue totals and per-cause outcome counts (adaptive.py)
+        report["adaptive"] = {
+            "switch_threshold": config.adaptive_switch_threshold,
+            "switch_hysteresis": config.adaptive_switch_hysteresis,
+            "values_rescued": out.get("values_rescued", 0),
+            "values_starved_queue_drop":
+                out.get("values_starved_queue_drop", 0),
+            "nodes_rescued": out.get("nodes_rescued", 0),
+            "switched_to_pull": out.get("adaptive_switched_to_pull", 0),
+            "pull_sent": out.get("adaptive_pull_sent", 0),
+            "pull_responses": out.get("adaptive_pull_responses", 0),
+            "pull_rescued": out.get("adaptive_pull_rescued", 0),
+            "pull_deferred": out.get("adaptive_pull_deferred", 0),
+            "pull_queue_dropped":
+                out.get("adaptive_pull_queue_dropped", 0),
+        }
+    return report
 
 
 # --------------------------------------------------------------------------
@@ -2941,6 +3082,14 @@ def _stepped_sweep_config(config: Config, i: int, origin_ranks):
         v = config.node_ingress_cap + i * config.step_size.as_int()
         return config.stepped(node_ingress_cap=v), \
             float(config.node_ingress_cap)
+    if tt == Testing.ADAPTIVE_THRESHOLD:
+        # traced adaptive knob (adaptive.py): the direction-switch
+        # coverage threshold — steps reuse one compiled executable and
+        # are lane-eligible on both the single-origin and traffic paths
+        v = min(config.adaptive_switch_threshold
+                + i * config.step_size.as_float(), 1.0)
+        return config.stepped(adaptive_switch_threshold=v), \
+            float(config.adaptive_switch_threshold)
     return config, 0.0  # NO_TEST
 
 
@@ -2950,7 +3099,8 @@ def _stepped_sweep_config(config: Config, i: int, origin_ranks):
 #: path, so they stay serial.
 LANE_SWEEP_TYPES = (Testing.MIN_INGRESS_NODES, Testing.PRUNE_STAKE_THRESHOLD,
                     Testing.FAIL_NODES, Testing.ROTATE_PROBABILITY,
-                    Testing.PACKET_LOSS, Testing.CHURN, Testing.PULL_FANOUT)
+                    Testing.PACKET_LOSS, Testing.CHURN, Testing.PULL_FANOUT,
+                    Testing.ADAPTIVE_THRESHOLD)
 
 
 def _lane_sweep_blocker(config: Config):
@@ -3095,6 +3245,30 @@ def main(argv=None) -> int:
                   "--gossip-mode (pull or push-pull); mode is push, so "
                   "every sweep point would be identical")
         return 1
+    if (config.test_type == Testing.ADAPTIVE_THRESHOLD
+            and config.gossip_mode != "adaptive"):
+        log.error("ERROR: --test-type adaptive-threshold requires "
+                  "--gossip-mode adaptive; the switch knobs are inert in "
+                  "mode %s, so every sweep point would be identical",
+                  config.gossip_mode)
+        return 1
+    if (config.test_type == Testing.ADAPTIVE_THRESHOLD
+            and config.num_simulations > 1):
+        # the stepper clamps thresholds at 1.0 — warn when the grid
+        # collapses into duplicate points instead of running them mutely
+        last = (config.adaptive_switch_threshold
+                + (config.num_simulations - 1)
+                * config.step_size.as_float())
+        if last > 1.0:
+            n_dup = sum(
+                1 for i in range(config.num_simulations)
+                if config.adaptive_switch_threshold
+                + i * config.step_size.as_float() > 1.0)
+            log.warning("WARNING: adaptive-threshold sweep clamps at 1.0 "
+                        "— the last %d of %d points run the identical "
+                        "threshold 1.0; shrink --step-size or "
+                        "--num-simulations for distinct points",
+                        n_dup, config.num_simulations)
 
     if config.traffic_values < 1:
         log.error("ERROR: --traffic-values must be >= 1 (the default 1 "
@@ -3121,11 +3295,28 @@ def main(argv=None) -> int:
                       "separate workload modes; traffic injects its own "
                       "stake-weighted origins")
             return 1
-        if config.has_pull:
+        if config.has_pull and config.gossip_mode != "adaptive":
             log.error("ERROR: the traffic subsystem models concurrent "
-                      "PUSH streams; --gossip-mode %s is not supported "
-                      "with it (future work)", config.gossip_mode)
+                      "PUSH streams; fixed --gossip-mode %s is not "
+                      "supported with it — per-value pull RESCUES are: "
+                      "use --gossip-mode adaptive", config.gossip_mode)
             return 1
+        if config.gossip_mode == "adaptive":
+            # a node-ingress-cap sweep steps the cap past the base value:
+            # guard the LAST point too, not just point 0, so the bound is
+            # a clean startup error instead of a mid-sweep assert
+            cap_max = config.node_ingress_cap
+            if (config.test_type == Testing.NODE_INGRESS_CAP
+                    and config.num_simulations > 1):
+                cap_max += ((config.num_simulations - 1)
+                            * config.step_size.as_int())
+            if cap_max >= 16384:
+                log.error("ERROR: adaptive traffic requires "
+                          "--node-ingress-cap < 16384 (engine sort-key "
+                          "packing bound; a node-ingress-cap sweep must "
+                          "keep every stepped point under it); caps that "
+                          "large are equivalent to no cap — use 0")
+                return 1
         allowed = TRAFFIC_SWEEP_TYPES + (Testing.NO_TEST,)
         if config.test_type not in allowed:
             log.error("ERROR: --test-type %s is not runnable in traffic "

@@ -24,6 +24,7 @@ class Testing(enum.Enum):
     PULL_FANOUT = "pull-fanout"
     TRAFFIC_RATE = "traffic-rate"
     NODE_INGRESS_CAP = "node-ingress-cap"
+    ADAPTIVE_THRESHOLD = "adaptive-threshold"
     NO_TEST = "no-test"
 
     def __str__(self):
@@ -41,6 +42,7 @@ class Testing(enum.Enum):
             Testing.PULL_FANOUT: "PullFanout",
             Testing.TRAFFIC_RATE: "TrafficRate",
             Testing.NODE_INGRESS_CAP: "NodeIngressCap",
+            Testing.ADAPTIVE_THRESHOLD: "AdaptiveThreshold",
             Testing.NO_TEST: "NoTest",
         }[self]
 
@@ -114,11 +116,20 @@ class Config:
     # Pull-gossip / anti-entropy (pull.py; both backends, bit-equivalent
     # decisions under the shared seed).  gossip_mode "push" keeps every
     # output bit-identical to the push-only simulator:
-    gossip_mode: str = "push"       # "push" | "pull" | "push-pull"
+    gossip_mode: str = "push"       # "push" | "pull" | "push-pull" |
+                                    # "adaptive" (adaptive.py)
     pull_fanout: int = 2            # pull requests per live node per round
     pull_interval: int = 1          # rounds between pull exchanges
     pull_bloom_fp_rate: float = 0.1  # bloom false-positive probability
     pull_request_cap: int = 0       # requests served per peer (<=0 = no cap)
+
+    # Adaptive push-pull (adaptive.py): direction-optimizing switch knobs,
+    # meaningful only under gossip_mode "adaptive".  Both are traced
+    # EngineKnobs leaves, so an adaptive-threshold sweep compiles once:
+    adaptive_switch_threshold: float = 0.9   # coverage fraction flipping
+                                             # a sim/value into pull phase
+    adaptive_switch_hysteresis: float = 0.05  # window below the threshold
+                                              # before flipping back
 
     # Concurrent traffic (traffic.py; both backends, bit-equivalent
     # decisions under the shared seed).  traffic_values == 1 with both

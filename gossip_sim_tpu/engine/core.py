@@ -70,6 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..adaptive import switch_update_arr
 from ..faults import (SALT_CHURN, SALT_EDGE, edge_u32_arr, node_u32_arr,
                       rate_threshold_arr, round_basis_arr, stake_bipartition)
 from ..identity import stake_buckets_array
@@ -137,6 +138,10 @@ class SimState(NamedTuple):
                                    # (the pull-tagged slice of hops_hist_acc)
     pull_rescued_acc: jax.Array    # [O, N] i32 measured rounds each node was
                                    # rescued by a pull response (pull.py)
+    adaptive_pull_on: jax.Array    # [O] bool direction bit (adaptive.py):
+                                   # the pull phase runs this round iff set;
+                                   # re-decided each round from push coverage
+                                   # (always False outside mode="adaptive")
 
 
 def make_cluster_tables(stakes_lamports: np.ndarray) -> ClusterTables:
@@ -333,6 +338,7 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
         hops_hist_acc=zi((O, H)),
         pull_hops_hist_acc=zi((O, H)),
         pull_rescued_acc=zi((O, N)),
+        adaptive_pull_on=jnp.zeros((O,), bool),
     )
 
 
@@ -920,6 +926,14 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
                          < kn.pull_fanout) & pull_on                 # [1, PS]
             base_ns = (peer_ns != self_col) & slot_live              # [N, PS]
             sent = base_ns[None, :, :] & (~failed)[:, :, None]       # [O,N,PS]
+            if p.has_adaptive:
+                # direction-optimizing switch (adaptive.py): the pull
+                # phase runs only for origin-sims whose carried direction
+                # bit is set — decided last round from push coverage.  A
+                # gated round is bit-identical to an off-interval pull
+                # round (zero counts, -1 trace slots), matching the
+                # AdaptiveOracle's empty_pull_round.
+                sent = sent & state.adaptive_pull_on[:, None, None]
             peer_o = jnp.broadcast_to(peer_ns[None], (O, N, PS))
             tf_pull = _lookup(failed.astype(jnp.int32),
                               peer_o.reshape(O, NPS), N,
@@ -1099,6 +1113,16 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             egress_round_all, ingress_round_all = deg_out, ingress_round
             new_pull_hist = state.pull_hops_hist_acc
             new_pull_rescued = state.pull_rescued_acc
+        if p.has_adaptive:
+            # re-decide the direction bit from THIS round's push coverage
+            # (adaptive.py switch_update_arr — the shared f64 formulation
+            # the AdaptiveOracle evaluates on the same integer counts)
+            new_adapt = switch_update_arr(
+                n_reached, N, state.adaptive_pull_on,
+                kn.adaptive_switch_threshold,
+                kn.adaptive_switch_hysteresis, jnp)
+        else:
+            new_adapt = state.adaptive_pull_on
         new_state = SimState(
             key=state.key,
             active=new_active,
@@ -1117,6 +1141,7 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             hops_hist_acc=state.hops_hist_acc + g * hr,
             pull_hops_hist_acc=new_pull_hist,
             pull_rescued_acc=new_pull_rescued,
+            adaptive_pull_on=new_adapt,
         )
         rows = {
             "coverage": (n_reached_all / N).astype(jnp.float32),
@@ -1150,6 +1175,11 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
         if p.has_pull:
             # pull-phase counters (pull.py accounting; all per-origin [O])
             rows.update(pull_counts)
+        if p.has_adaptive:
+            # direction-switch telemetry (adaptive.py): the bit in effect
+            # this round and whether this round's coverage flipped it
+            rows["adaptive_pull_active"] = state.adaptive_pull_on
+            rows["adaptive_switched"] = new_adapt != state.adaptive_pull_on
         if detail or trace:
             rows["stranded_mask"] = stranded
             rows["dist"] = jnp.where(reached, dist, -1).astype(jnp.int32)

@@ -95,6 +95,13 @@ class EngineKnobs(NamedTuple):
     pull_interval: np.int32               # rounds between pull exchanges
     pull_bloom_fp_rate: np.float64        # bloom false-positive probability
     pull_request_cap: np.int32            # served requests per peer (<=0 off)
+    # adaptive push-pull knobs (gossip_mode="adaptive"); the direction
+    # switch is compiled in under the static mode, these only position it —
+    # an ADAPTIVE_THRESHOLD sweep reuses one compiled executable
+    adaptive_switch_threshold: np.float64  # coverage fraction flipping a
+                                           # sim/value into its pull phase
+    adaptive_switch_hysteresis: np.float64  # window below the threshold
+                                            # before flipping back to push
     # concurrent-traffic knobs (traffic.py); the traffic engine itself is
     # gated on the static ``traffic_slots`` — these only shape it, so a
     # traffic-rate or queue-cap sweep reuses one compiled executable
@@ -133,8 +140,10 @@ class EngineStatic(NamedTuple):
     # Gossip mode selects which protocol phases exist in the compiled graph
     # (pull.py): "push" is the reference graph (bit-identical to the
     # pre-pull engine), "pull" disables the push phase, "push-pull" runs
-    # both.  ``pull_slots`` is the RESOLVED static pull-request width (0
-    # when the mode has no pull phase).
+    # both, "adaptive" compiles both phases plus the direction-optimizing
+    # switch (push while coverage is low, pull-phase activation once it
+    # crosses the traced threshold).  ``pull_slots`` is the RESOLVED static
+    # pull-request width (0 when the mode has no pull phase).
     gossip_mode: str = "push"
     pull_slots: int = 0
     # Concurrent-traffic geometry (traffic.py / engine/traffic.py):
@@ -162,6 +171,10 @@ class EngineStatic(NamedTuple):
     @property
     def has_push(self) -> bool:
         return self.gossip_mode != "pull"
+
+    @property
+    def has_adaptive(self) -> bool:
+        return self.gossip_mode == "adaptive"
 
     @property
     def prune_cap(self) -> int:
@@ -274,6 +287,18 @@ class EngineParams(NamedTuple):
                                      # (static shape; 0 = auto:
                                      # max(8, pull_fanout) so fanout sweeps
                                      # within 8 compile once)
+
+    # Adaptive push-pull (gossip_mode="adaptive"): direction-optimizing
+    # gossip per "Implementing Push-Pull Efficiently in GraphBLAS" — push
+    # while the infected set is small, activate the pull phase once
+    # coverage crosses the switch threshold (and push RMR explodes).  Both
+    # knobs are traced (EngineKnobs): threshold sweeps compile once.  The
+    # decision compares integer coverage counts against ``threshold * N``
+    # in f64, identically in both backends (bit-exact by construction).
+    adaptive_switch_threshold: float = 0.9   # coverage fraction that flips
+                                             # a sim/value into pull phase
+    adaptive_switch_hysteresis: float = 0.05  # flip back to push only when
+                                              # coverage < thr - hysteresis
 
     # Concurrent-traffic knobs (traffic.py).  ``traffic_values`` is the
     # static M-value slot capacity; with the default 1 AND both queue caps
@@ -411,6 +436,10 @@ class EngineParams(NamedTuple):
             pull_interval=np.int32(max(1, self.pull_interval)),
             pull_bloom_fp_rate=np.float64(self.pull_bloom_fp_rate),
             pull_request_cap=np.int32(self.pull_request_cap),
+            adaptive_switch_threshold=np.float64(
+                self.adaptive_switch_threshold),
+            adaptive_switch_hysteresis=np.float64(
+                self.adaptive_switch_hysteresis),
             traffic_rate=np.int32(self.traffic_rate),
             node_ingress_cap=np.int32(self.node_ingress_cap),
             node_egress_cap=np.int32(self.node_egress_cap),
@@ -438,8 +467,16 @@ class EngineParams(NamedTuple):
         if self.partition_at >= 0 and self.heal_at >= 0:
             assert self.heal_at >= self.partition_at, (
                 "heal_at must not precede partition_at")
-        assert self.gossip_mode in ("push", "pull", "push-pull"), (
+        assert self.gossip_mode in ("push", "pull", "push-pull",
+                                    "adaptive"), (
             f"unknown gossip_mode: {self.gossip_mode!r}")
+        if self.gossip_mode == "adaptive":
+            assert 0.0 < self.adaptive_switch_threshold <= 1.0, (
+                "adaptive_switch_threshold must be in (0, 1]")
+            assert 0.0 <= self.adaptive_switch_hysteresis \
+                < self.adaptive_switch_threshold, (
+                "adaptive_switch_hysteresis must be in "
+                "[0, adaptive_switch_threshold)")
         if self.has_pull:
             assert self.pull_fanout >= 1, "pull_fanout must be >= 1"
             assert self.pull_interval >= 1, "pull_interval must be >= 1"
@@ -453,11 +490,21 @@ class EngineParams(NamedTuple):
             assert self.traffic_rate >= 0, "traffic_rate must be >= 0"
             assert self.traffic_stall_rounds >= 1, (
                 "traffic_stall_rounds must be >= 1")
-            assert self.gossip_mode == "push", (
+            assert self.gossip_mode in ("push", "adaptive"), (
                 "the traffic subsystem models concurrent PUSH streams; "
-                "pull modes are not supported with traffic_values > 1 or "
-                "queue caps (future work)")
+                "fixed pull modes are not supported with traffic_values "
+                "> 1 or queue caps — per-value pull RESCUES are: use "
+                "--gossip-mode adaptive (adaptive.py)")
             assert not (self.fail_at >= 0 and self.fail_fraction > 0.0), (
                 "one-shot fail_at uses PRNG draws the traffic oracle "
                 "cannot replay; use churn_fail_rate with traffic instead")
+            if self.gossip_mode == "adaptive":
+                # the pull-rescue ingress continuation routes the peer's
+                # consumed push budget through the i32 sort-join fast path,
+                # whose packed values must stay under the minimum node-id
+                # packing base (engine/core.py PACK)
+                assert self.node_ingress_cap < 16384, (
+                    "adaptive traffic requires node_ingress_cap < 16384 "
+                    "(sort-key packing bound); caps that large are "
+                    "equivalent to no cap — use 0")
         return self

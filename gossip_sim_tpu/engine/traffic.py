@@ -47,6 +47,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..adaptive import (SALT_ADAPT_PBLOOM, SALT_ADAPT_PCLASS,
+                        SALT_ADAPT_PLOSS, SALT_ADAPT_PMEMBER,
+                        switch_update_arr)
 from ..faults import (SALT_CHURN, edge_u32_arr, node_u32_arr,
                       rate_threshold_arr, round_basis_arr)
 from ..traffic import (SALT_TRAFFIC_LOSS, SALT_TRAFFIC_OCLASS,
@@ -101,6 +104,11 @@ class TrafficState(NamedTuple):
     sent_acc: jax.Array    # [N] i32 wire messages per sender
     recv_acc: jax.Array    # [N] i32 accepted messages per receiver
     prune_acc: jax.Array   # [N] i32 prune messages per pruner
+    # adaptive push-pull (adaptive.py; all-zero outside mode "adaptive"
+    # except v_qdrop, which root-causes starvation in every traffic mode)
+    v_pull: jax.Array      # [V] bool value is in its pull-rescue phase
+    v_rescued: jax.Array   # [V] i32 nodes delivered via pull rescue
+    v_qdrop: jax.Array     # [V] i32 ingress queue drops that hit the value
 
 
 def device_traffic_tables(stakes) -> TrafficTables:
@@ -143,6 +151,8 @@ def init_traffic_state(stakes, params, seed: int) -> TrafficState:
         ret_acc=jnp.int32(0), conv_acc=jnp.int32(0),
         defer_acc=zi((N,)), qdrop_acc=zi((N,)),
         sent_acc=zi((N,)), recv_acc=zi((N,)), prune_acc=zi((N,)),
+        v_pull=jnp.zeros((V,), bool),
+        v_rescued=zi((V,)), v_qdrop=zi((V,)),
     )
 
 
@@ -213,9 +223,17 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
         rc_slo = jnp.where(do_inj[:, None, None], 0, state.rc_slo)
         rc_ups = jnp.where(do_inj[:, None], 0, state.rc_upserts)
         next_vid = state.next_vid + n_inj
+        # adaptive direction state + starvation counters reset with the
+        # slot; a fresh value always starts in its push phase
+        v_pull = jnp.where(do_inj, False, state.v_pull)
+        v_rescued = jnp.where(do_inj, 0, state.v_rescued)
+        v_qdrop = jnp.where(do_inj, 0, state.v_qdrop)
         # the prune bits verb 1 consults this round (pre-prune-apply,
         # pre-rotation) — the flight recorder's per-value snapshot
         pruned_pre = pruned
+        # pre-delivery holder/hop state: push senders, the pull-rescue
+        # responders, and the requester set all consult this snapshot
+        v_holder_pre, v_hop_pre = v_holder, v_hop
 
     with jax.named_scope("traffic/candidates"):
         # ---- verb 1 with a value axis: first F valid SHARED slots -------
@@ -225,6 +243,11 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
         tfail_ns = (_lookup(failed.astype(jnp.int32)[None, :], q, N,
                             pack).reshape(N, S) == 1) & is_peer
         sender = v_live[:, None] & v_holder & (~failed)[None, :]    # [V, N]
+        if p.has_adaptive:
+            # direction flip (adaptive.py): a pull-phase value generates
+            # NO push candidates — its bandwidth share moves to the
+            # rescue requests of the nodes still missing it
+            sender = sender & (~v_pull)[:, None]
         peer_b = jnp.broadcast_to(active[None], (V, N, S))
         valid = (sender[:, :, None] & is_peer[None] & ~pruned
                  & (peer_b != v_origin[:, None, None]))
@@ -375,6 +398,208 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
         inb = jnp.where(inb_real, kvB[:, :NK] & (pack - 1), N).reshape(V, N, K)
         inb_shi = jnp.where(inb_real, hiB[:, :NK], 0).reshape(V, N, K)
         inb_slo = jnp.where(inb_real, loB[:, :NK], 0).reshape(V, N, K)
+
+    # per-value ingress-drop attribution (starved_queue_drop root-causing;
+    # tracked in every traffic mode, not just adaptive)
+    qdrop_v = jnp.sum(qdropped, axis=(1, 2), dtype=jnp.int32)       # [V]
+    v_qdrop = v_qdrop + qdrop_v
+
+    pull_del = None
+    adaptive_counts = {}
+    if p.has_adaptive:
+        with jax.named_scope("traffic/pull_rescue"):
+            # ---- adaptive pull-rescue phase (adaptive.py spec) ----------
+            # Per pull-phase value, every live node still missing it
+            # sends pull_fanout stake-weighted requests, decorrelated per
+            # value id.  Requests CONTINUE the push phase's per-node
+            # egress/ingress budgets (value-major order after all push
+            # messages); responses ride the reverse path of an accepted
+            # request and the requester keeps the minimum
+            # (clamped hop, clamp bit, peer) response — the exact loop
+            # TrafficOracle runs.
+            PS = p.pull_slots
+            NPS = N * PS
+            L2 = V * NPS
+            v_pull_eff = v_pull & v_live                             # [V]
+            b_pc = round_basis_arr(kn.impair_seed, it, SALT_ADAPT_PCLASS,
+                                   jnp)
+            b_pm = round_basis_arr(kn.impair_seed, it, SALT_ADAPT_PMEMBER,
+                                   jnp)
+            vb_c = value_basis_arr(b_pc, v_vid, jnp)                 # [V]
+            vb_m = value_basis_arr(b_pm, v_vid, jnp)
+            nodes_u = jnp.arange(N, dtype=jnp.uint32)[None, :, None]
+            slots_u = jnp.arange(PS, dtype=jnp.uint32)[None, None, :]
+            peers = class_draw_arr(
+                ttables,
+                u01_arr(edge_u32_arr(vb_c[:, None, None], nodes_u,
+                                     slots_u, jnp), jnp),
+                u01_arr(edge_u32_arr(vb_m[:, None, None], nodes_u,
+                                     slots_u, jnp), jnp),
+                jnp).astype(jnp.int32)                               # [V,N,PS]
+            slot_live_p = (jnp.arange(PS, dtype=jnp.int32)[None, None, :]
+                           < kn.pull_fanout)
+            want = (v_pull_eff[:, None, None]
+                    & (~v_holder_pre)[:, :, None]
+                    & (~failed)[None, :, None]
+                    & slot_live_p
+                    & (peers != iota_n[None, :, None]))
+            # egress budget: continue each requester's push usage in
+            # value-major (value, slot) order
+            push_out = jnp.sum(sent, axis=(0, 2), dtype=jnp.int32)   # [N]
+            cpw = jnp.moveaxis(want.astype(jnp.int32), 0, 1
+                               ).reshape(N, V * PS)
+            prank = jnp.moveaxis(
+                (jnp.cumsum(cpw, axis=1) - cpw).reshape(N, V, PS), 0, 1)
+            p_sent = want & (~ecap_on
+                             | (push_out[None, :, None] + prank
+                                < kn.node_egress_cap))
+            p_def = want & ~p_sent
+            # network precedence: failed peer > partition > request loss
+            q2 = jnp.minimum(peers, N - 1).reshape(1, L2)
+            peer_failed = (_lookup(failed.astype(jnp.int32)[None, :], q2,
+                                   N, pack).reshape(V, N, PS) == 1)
+            live_req = p_sent & ~peer_failed
+            p_failed_target = p_sent & peer_failed
+            p_sup = p_drop = None
+            if p.has_partition:
+                part_on2 = ((kn.partition_at >= 0)
+                            & (it >= kn.partition_at)
+                            & ((kn.heal_at < 0) | (it < kn.heal_at)))
+                side_dst2 = tables.side[jnp.minimum(peers, N)]
+                p_sup = (live_req & part_on2
+                         & (tables.side[:N][None, :, None] != side_dst2))
+                live_req = live_req & ~p_sup
+            if p.has_loss:
+                b_pl = round_basis_arr(kn.impair_seed, it,
+                                       SALT_ADAPT_PLOSS, jnp)
+                vb_l = value_basis_arr(b_pl, v_vid, jnp)
+                ue2 = edge_u32_arr(vb_l[:, None, None],
+                                   iota_n.astype(jnp.uint32)[None, :, None],
+                                   peers.astype(jnp.uint32), jnp)
+                p_drop = live_req & (
+                    ue2.astype(jnp.uint64)
+                    < rate_threshold_arr(kn.packet_loss_rate, jnp))
+                live_req = live_req & ~p_drop
+            req_arrived = live_req                               # [V,N,PS]
+
+            # ingress budget: requests rank per peer AFTER the round's
+            # push acceptances, in value-major (value, requester, slot)
+            # order — one flat sort, same pseudo-entry trick as push
+            peer_flat2 = peers.reshape(1, L2)
+            arr_flat2 = req_arrived.reshape(1, L2)
+            order2 = jnp.arange(L2, dtype=jnp.int32)[None, :]
+            kd2c = jnp.concatenate(
+                [jnp.where(arr_flat2, peer_flat2, N), iota_n[None, :]],
+                axis=1)
+            ord2c = jnp.concatenate(
+                [order2, jnp.full((1, N), BIG, jnp.int32)], axis=1)
+            k3, ord3 = lax.sort((kd2c, ord2c), dimension=-1, num_keys=2)
+            rank3 = _rank_in_run(k3)
+            is_ps3 = (ord3 == BIG) & (k3 < N)
+            cnt_k3 = jnp.where(is_ps3, k3, BIG)
+            _, arrcnt3 = lax.sort((cnt_k3, rank3), dimension=-1,
+                                  num_keys=1)
+            req_arrived_node = arrcnt3[0, :N]                    # [N]
+            _, rank_back3 = lax.sort((ord3, rank3), dimension=-1,
+                                     num_keys=1)
+            req_rank = rank_back3[0, :L2].reshape(V, N, PS)
+            # the peer's already-consumed push ingress (< pack by the
+            # validate() cap bound, so the sort-join fast path is exact)
+            base_tab = jnp.clip(
+                jnp.minimum(accepted_node.astype(jnp.int32),
+                            jnp.maximum(kn.node_ingress_cap, 0)),
+                0, pack - 1)
+            base_req = _lookup(base_tab[None, :], q2, N,
+                               pack).reshape(V, N, PS)
+            req_acc = req_arrived & (
+                ~icap_on | (base_req + req_rank < kn.node_ingress_cap))
+            req_qdropped = req_arrived & ~req_acc
+
+            # response decision: peer holds (pre-delivery state) and the
+            # requester's per-value bloom digest did not false-positive
+            holds_req = _lookup(v_holder_pre.astype(jnp.int32),
+                                peers.reshape(V, NPS), N,
+                                pack).reshape(V, N, PS) == 1
+            b_pb = round_basis_arr(kn.impair_seed, it, SALT_ADAPT_PBLOOM,
+                                   jnp)
+            vb_b = value_basis_arr(b_pb, v_vid, jnp)
+            fp_req = (node_u32_arr(vb_b[:, None],
+                                   jnp.arange(N, dtype=jnp.uint32)[None, :],
+                                   jnp).astype(jnp.uint64)
+                      < rate_threshold_arr(kn.pull_bloom_fp_rate, jnp))
+            transfer = req_acc & holds_req & ~fp_req[:, :, None]
+
+            # delivery: minimum (clamped hop, clamp bit, peer) response
+            hv = jnp.where(v_holder_pre, v_hop_pre, 0)
+            d_hop = _lookup(hv, peers.reshape(V, NPS), N,
+                            pack).reshape(V, N, PS)
+            th2 = d_hop + 1
+            ch2 = jnp.minimum(th2, H - 1)
+            clampb = (th2 > H - 1).astype(jnp.int32)
+            rkey = jnp.where(transfer,
+                             (((ch2 << 1) | clampb) << pb) | peers, BIG)
+            win = jnp.min(rkey, axis=-1)                         # [V, N]
+            pull_del = (win != BIG) & ~v_holder   # push deliveries win ties
+            win_ch = win >> (pb + 1)
+            win_clamp = (win >> pb) & 1
+            v_holder = v_holder | pull_del
+            v_hop = jnp.where(pull_del, win_ch, v_hop)
+            pull_clamped = jnp.sum(pull_del & (win_clamp == 1),
+                                   dtype=jnp.int32)
+            hop_clamped = hop_clamped + pull_clamped
+            pull_hop_row = jnp.where(pull_del, win_ch, -1)       # [V, N]
+
+            # per-value / per-node accounting
+            served_v = jnp.sum(req_acc, axis=(1, 2), dtype=jnp.int32)
+            resp_v = jnp.sum(transfer, axis=(1, 2), dtype=jnp.int32)
+            v_m = v_m + served_v + resp_v
+            v_rescued = v_rescued + jnp.sum(pull_del, axis=-1,
+                                            dtype=jnp.int32)
+            v_qdrop = v_qdrop + jnp.sum(req_qdropped, axis=(1, 2),
+                                        dtype=jnp.int32)
+            preq_out = jnp.sum(p_sent, axis=(0, 2), dtype=jnp.int32)
+            p_def_node = jnp.sum(p_def, axis=(0, 2), dtype=jnp.int32)
+            resp_in = jnp.sum(transfer, axis=(0, 2), dtype=jnp.int32)
+            rem_node = jnp.maximum(
+                kn.node_ingress_cap - accepted_node.astype(jnp.int32), 0)
+            served_node = jnp.where(icap_on,
+                                    jnp.minimum(req_arrived_node, rem_node),
+                                    req_arrived_node)            # [N]
+            pull_qdrop_node = req_arrived_node - served_node
+
+            def _per_peer_count(mask):
+                kdp = jnp.concatenate(
+                    [jnp.where(mask.reshape(1, L2), peer_flat2, N),
+                     iota_n[None, :]], axis=1)
+                kvp = jnp.concatenate(
+                    [jnp.zeros((1, L2), jnp.int32),
+                     jnp.full((1, N), BIG)], axis=1)
+                skp, svp = lax.sort((kdp, kvp), dimension=-1, num_keys=2)
+                rkp = _rank_in_run(skp)
+                ckp = jnp.where((svp == BIG) & (skp < N), skp, BIG)
+                _, cntp = lax.sort((ckp, rkp), dimension=-1, num_keys=1)
+                return cntp[0, :N]
+
+            resp_peer = _per_peer_count(transfer)                # [N]
+            zero_a = jnp.int32(0)
+            adaptive_counts = {
+                "pull_sent": jnp.sum(p_sent, dtype=jnp.int32),
+                "pull_deferred": jnp.sum(p_def, dtype=jnp.int32),
+                "pull_failed_target": jnp.sum(p_failed_target,
+                                              dtype=jnp.int32),
+                "pull_suppressed": (jnp.sum(p_sup, dtype=jnp.int32)
+                                    if p_sup is not None else zero_a),
+                "pull_dropped": (jnp.sum(p_drop, dtype=jnp.int32)
+                                 if p_drop is not None else zero_a),
+                "pull_arrived": jnp.sum(req_arrived, dtype=jnp.int32),
+                "pull_queue_dropped": jnp.sum(req_qdropped,
+                                              dtype=jnp.int32),
+                "pull_served": jnp.sum(served_v, dtype=jnp.int32),
+                "pull_responses": jnp.sum(resp_v, dtype=jnp.int32),
+                "pull_rescued": jnp.sum(pull_del, dtype=jnp.int32),
+                "pull_active_values": jnp.sum(v_pull_eff,
+                                              dtype=jnp.int32),
+            }
 
     with jax.named_scope("traffic/rc_merge"):
         # ---- received-cache merge (verb 2 tail, O -> V) -----------------
@@ -556,7 +781,13 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
 
     with jax.named_scope("traffic/retire"):
         # ---- stall tracking, retirement, slot recycle -------------------
-        progress = jnp.sum(new_del, axis=-1, dtype=jnp.int32) > 0   # [V]
+        prog_cnt = jnp.sum(new_del, axis=-1, dtype=jnp.int32)       # [V]
+        if pull_del is not None:
+            # pull-rescue deliveries count as progress (they reset the
+            # stall clock exactly like push first deliveries)
+            prog_cnt = prog_cnt + jnp.sum(pull_del, axis=-1,
+                                          dtype=jnp.int32)
+        progress = prog_cnt > 0                                     # [V]
         v_stall = jnp.where(~v_live, 0,
                             jnp.where(do_inj | progress, 0,
                                       state.v_stall + 1))
@@ -566,6 +797,20 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
         v_live_post = v_live & ~retire
         hops_sum = jnp.sum(jnp.where(v_holder, v_hop, 0), axis=-1,
                            dtype=jnp.int32)
+        # adaptive direction switch (end of round, survivors only;
+        # adaptive.py switch_update_arr — the shared f64 formulation)
+        if p.has_adaptive:
+            new_v_pull = jnp.where(
+                v_live_post,
+                switch_update_arr(holders, N, v_pull,
+                                  kn.adaptive_switch_threshold,
+                                  kn.adaptive_switch_hysteresis, jnp),
+                False)
+            switched = jnp.sum(v_live_post & new_v_pull & ~v_pull,
+                               dtype=jnp.int32)
+        else:
+            new_v_pull = v_pull
+            switched = jnp.int32(0)
 
     with jax.named_scope("traffic/round_stats"):
         g = (it >= kn.warm_up_rounds).astype(jnp.int32)
@@ -575,6 +820,23 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
         n_retired = jnp.sum(retire, dtype=jnp.int32)
         n_conv = jnp.sum(retire & full_v, dtype=jnp.int32)
         zero_s = jnp.int32(0)
+        if pull_del is not None:
+            # pull-rescue traffic joins every per-node accounting stream:
+            # requests are requester egress + peer ingress, responses are
+            # peer egress + requester ingress, deferrals/queue drops join
+            # the same depth counters the oracle's shared loops fill
+            node_deferred = node_deferred + p_def_node
+            sent_node_all = sent_node + preq_out + resp_peer
+            recv_node_all = (accepted_node.astype(jnp.int32)
+                             + served_node + resp_in)
+            qdrop_node_all = (qdrop_node.astype(jnp.int32)
+                              + pull_qdrop_node)
+            inflow_node = accepted_node.astype(jnp.int32) + served_node
+        else:
+            sent_node_all = sent_node
+            recv_node_all = accepted_node.astype(jnp.int32)
+            qdrop_node_all = qdrop_node.astype(jnp.int32)
+            inflow_node = accepted_node.astype(jnp.int32)
         new_state = TrafficState(
             active=new_active, failed=failed, next_vid=next_vid,
             v_live=v_live_post, v_vid=v_vid, v_origin=v_origin,
@@ -587,11 +849,12 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
             ret_acc=state.ret_acc + g * n_retired,
             conv_acc=state.conv_acc + g * n_conv,
             defer_acc=state.defer_acc + g * node_deferred,
-            qdrop_acc=state.qdrop_acc + g * qdrop_node.astype(jnp.int32),
-            sent_acc=state.sent_acc + g * sent_node,
-            recv_acc=state.recv_acc + g * accepted_node.astype(jnp.int32),
+            qdrop_acc=state.qdrop_acc + g * qdrop_node_all,
+            sent_acc=state.sent_acc + g * sent_node_all,
+            recv_acc=state.recv_acc + g * recv_node_all,
             prune_acc=state.prune_acc
             + g * jnp.sum(n_pruned, axis=0, dtype=jnp.int32),
+            v_pull=new_v_pull, v_rescued=v_rescued, v_qdrop=v_qdrop,
         )
         rows = {
             "injected": n_inj,
@@ -614,7 +877,7 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
             "converged": n_conv,
             "hop_clamped": hop_clamped,
             "qdepth_max": jnp.max(node_deferred),
-            "inflow_max": jnp.max(accepted_node).astype(jnp.int32),
+            "inflow_max": jnp.max(inflow_node).astype(jnp.int32),
             "inb_dropped": inb_dropped,
             "rc_overflow": rc_overflow,
             # per-value retirement records (valid where ret_mask)
@@ -626,15 +889,23 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
             "ret_m": v_m,
             "ret_full": full_v,
             "ret_hops_sum": hops_sum,
+            # starvation root-causing (terminal-cause attribution)
+            "ret_rescued": v_rescued,
+            "ret_qdrop": v_qdrop,
         }
+        if p.has_adaptive:
+            # adaptive pull-rescue counters (sim_adaptive series) + the
+            # end-of-round direction flips
+            rows.update(adaptive_counts)
+            rows["switched_to_pull"] = switched
         if detail or trace:
             rows["live_mask"] = v_live_post
             rows["t_holder"] = v_holder
             rows["t_hop"] = jnp.where(v_holder, v_hop, -1)
             rows["node_deferred"] = node_deferred
-            rows["node_queue_dropped"] = qdrop_node.astype(jnp.int32)
-            rows["node_sent"] = sent_node
-            rows["node_recv"] = accepted_node.astype(jnp.int32)
+            rows["node_queue_dropped"] = qdrop_node_all
+            rows["node_sent"] = sent_node_all
+            rows["node_recv"] = recv_node_all
         if trace:
             # flight recorder v3 (obs/trace.py): value-slot event rows.
             # codes: accepted(1) / failed_target(2) / suppressed(3) /
@@ -658,6 +929,12 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
             rows["trace_pruned"] = pruned_pre
             rows["trace_failed"] = failed
             rows["trace_prunes"] = m_prunes
+            if p.has_adaptive:
+                # trace schema v4: the per-value direction bit in effect
+                # this round + per-node rescue deliveries (hop, -1 none)
+                rows["trace_value_pull"] = (v_pull & v_live).astype(
+                    jnp.int8)
+                rows["trace_pull_hop"] = pull_hop_row
             PC = p.traffic_prune_cap
 
             def _prune_pairs():

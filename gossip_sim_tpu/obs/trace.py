@@ -69,12 +69,19 @@ log = logging.getLogger("gossip_sim_tpu.obs")
 # the value-id column — traffic-mode traces carry per-value-slot event
 # arrays (``value_id``/``value_origin`` identify each slot's in-flight
 # value per round; delivery and prune arrays gain a leading V axis) and
-# the ``traffic_slots`` manifest key.  New traces are written as v3
-# (traffic arrays present only in traffic mode); v1/v2 remain readable.
+# the ``traffic_slots`` manifest key.  v4 (adaptive push-pull,
+# adaptive.py): adds the switch-round events — single-origin adaptive
+# traces carry the per-round direction bit (``adaptive_on``), traffic
+# adaptive traces the per-value phase bit (``value_pull``) and per-node
+# rescue deliveries (``pull_hop`` with a V axis).  New traces are written
+# as v4 (adaptive arrays present only under gossip_mode "adaptive");
+# v1/v2/v3 remain readable.
 TRACE_SCHEMA_V1 = "gossip-sim-tpu/trace/v1"
 TRACE_SCHEMA_V2 = "gossip-sim-tpu/trace/v2"
-TRACE_SCHEMA = "gossip-sim-tpu/trace/v3"
-READABLE_SCHEMAS = (TRACE_SCHEMA_V1, TRACE_SCHEMA_V2, TRACE_SCHEMA)
+TRACE_SCHEMA_V3 = "gossip-sim-tpu/trace/v3"
+TRACE_SCHEMA = "gossip-sim-tpu/trace/v4"
+READABLE_SCHEMAS = (TRACE_SCHEMA_V1, TRACE_SCHEMA_V2, TRACE_SCHEMA_V3,
+                    TRACE_SCHEMA)
 MANIFEST_NAME = "manifest.json"
 
 # per-slot outcome codes (shared with engine/core.py round_step and the
@@ -137,18 +144,36 @@ TRAFFIC_ARRAY_SPECS = {
     "prunes_total": ("int32", ("V",)),
 }
 
+#: v4 adaptive arrays (adaptive.py), present when the manifest's
+#: ``gossip_mode`` is "adaptive".  Single-origin traces carry the
+#: per-round direction bit; traffic traces the per-value phase bit plus
+#: the per-node rescue deliveries (hop, -1 = no rescue) that make every
+#: rescue attributable to its value slot (stats/edges.py).
+ADAPTIVE_ARRAY_SPECS = {
+    "adaptive_on": ("int8", ()),
+}
+TRAFFIC_ADAPTIVE_ARRAY_SPECS = {
+    "value_pull": ("int8", ("V",)),
+    "pull_hop": ("int16", ("V", "N")),
+}
+
 #: every array name a non-traffic readable schema can carry
-ALL_ARRAY_SPECS = {**ARRAY_SPECS, **PULL_ARRAY_SPECS}
+ALL_ARRAY_SPECS = {**ARRAY_SPECS, **PULL_ARRAY_SPECS,
+                   **ADAPTIVE_ARRAY_SPECS}
+#: every array name a traffic readable schema can carry
+ALL_TRAFFIC_ARRAY_SPECS = {**TRAFFIC_ARRAY_SPECS,
+                           **TRAFFIC_ADAPTIVE_ARRAY_SPECS}
 
 
 def specs_for_manifest(manifest: dict) -> dict:
     """The array-spec dict a manifest's schema/mode implies (v1 manifests
-    and v2 push-mode manifests carry the base arrays only; v3 traffic
-    manifests — ``traffic_slots`` > 0 — the traffic arrays)."""
+    and v2 push-mode manifests carry the base arrays only; v3+ traffic
+    manifests — ``traffic_slots`` > 0 — the traffic arrays; v4 adaptive
+    manifests additionally the switch-event arrays)."""
     if int(manifest.get("traffic_slots") or 0) > 0:
-        return {name: TRAFFIC_ARRAY_SPECS[name]
+        return {name: ALL_TRAFFIC_ARRAY_SPECS[name]
                 for name in (manifest.get("arrays") or TRAFFIC_ARRAY_SPECS)
-                if name in TRAFFIC_ARRAY_SPECS}
+                if name in ALL_TRAFFIC_ARRAY_SPECS}
     return {name: ALL_ARRAY_SPECS[name]
             for name in (manifest.get("arrays") or ARRAY_SPECS)
             if name in ALL_ARRAY_SPECS}
@@ -198,22 +223,39 @@ _MATCH_KEYS = ("schema", "backend", "num_nodes", "push_fanout",
                "gossip_mode", "pull_slots", "traffic_slots")
 
 
+#: adaptive engine trace rows (mode "adaptive") -> v4 arrays
+_ENGINE_ADAPTIVE_ROW_MAP = {
+    "adaptive_pull_active": "adaptive_on",
+}
+_TRAFFIC_ADAPTIVE_ROW_MAP = {
+    "trace_value_pull": "value_pull",
+    "trace_pull_hop": "pull_hop",
+}
+
+
 def block_from_engine_rows(rows) -> dict:
     """Engine harvest rows (numpy, ``[R, O, ...]``) -> writer block dict.
-    Pull-phase rows ride along when the engine emitted them (pull modes)."""
+    Pull-phase and adaptive rows ride along when the engine emitted them
+    (pull / adaptive modes)."""
     block = {seg: np.asarray(rows[eng])
              for eng, seg in _ENGINE_ROW_MAP.items()}
-    for eng, seg in _ENGINE_PULL_ROW_MAP.items():
-        if eng in rows:
-            block[seg] = np.asarray(rows[eng])
+    for rowmap in (_ENGINE_PULL_ROW_MAP, _ENGINE_ADAPTIVE_ROW_MAP):
+        for eng, seg in rowmap.items():
+            if eng in rows:
+                block[seg] = np.asarray(rows[eng])
     return block
 
 
 def traffic_block_from_engine_rows(rows) -> dict:
     """Traffic-engine harvest rows (numpy, ``[R, V, ...]``) -> writer
-    block dict for a ``traffic_slots > 0`` (v3) trace."""
-    return {seg: np.asarray(rows[eng])
-            for eng, seg in _TRAFFIC_ENGINE_ROW_MAP.items()}
+    block dict for a ``traffic_slots > 0`` (v3+) trace; the v4 adaptive
+    arrays ride along under gossip_mode "adaptive"."""
+    block = {seg: np.asarray(rows[eng])
+             for eng, seg in _TRAFFIC_ENGINE_ROW_MAP.items()}
+    for eng, seg in _TRAFFIC_ADAPTIVE_ROW_MAP.items():
+        if eng in rows:
+            block[seg] = np.asarray(rows[eng])
+    return block
 
 
 def _atomic_write_bytes(path: str, payload: bytes) -> None:
@@ -280,10 +322,14 @@ class TraceWriter:
             # v3 traffic mode: value-slot event arrays; there is no origin
             # column (values carry their own origins per round)
             self.array_specs = dict(TRAFFIC_ARRAY_SPECS)
+            if gossip_mode == "adaptive":
+                self.array_specs.update(TRAFFIC_ADAPTIVE_ARRAY_SPECS)
         else:
             self.array_specs = dict(ARRAY_SPECS)
             if gossip_mode != "push":
                 self.array_specs.update(PULL_ARRAY_SPECS)
+            if gossip_mode == "adaptive":
+                self.array_specs.update(ADAPTIVE_ARRAY_SPECS)
         from ..traffic import TRAFFIC_CODE_NAMES
         self.manifest = {
             "schema": TRACE_SCHEMA,
@@ -464,8 +510,14 @@ class OracleTraceCollector:
         self.array_specs = dict(ARRAY_SPECS)
         if self.gossip_mode != "push":
             self.array_specs.update(PULL_ARRAY_SPECS)
+        if self.gossip_mode == "adaptive":
+            self.array_specs.update(ADAPTIVE_ARRAY_SPECS)
         self._pre = None
         self._rounds = []     # [(round, {name: [O=1, ...] array})]
+        #: adaptive mode: the CLI sets this per round to the direction bit
+        #: in effect BEFORE the round's switch update (the engine's
+        #: adaptive_pull_active row)
+        self.adaptive_on = False
 
     def begin_round(self, cluster, node_map) -> None:
         """PRE-round snapshot (active sets + pruned bits as verb 1 will see
@@ -560,6 +612,8 @@ class OracleTraceCollector:
                 row["pull_peers"] = np.full((N, self.Q), -1, np.int16)
                 row["pull_code"] = np.zeros((N, self.Q), np.int8)
                 row["pull_hop"] = np.full(N, -1, np.int16)
+        if self.gossip_mode == "adaptive":
+            row["adaptive_on"] = np.int8(1 if self.adaptive_on else 0)
         self._rounds.append((int(it), row))
 
     def flush(self):
@@ -675,23 +729,34 @@ def validate_trace_manifest(manifest: dict) -> list:
     for name in base_specs:
         if name not in (manifest.get("arrays") or {}):
             problems.append(f"arrays entry missing: {name}")
-    if manifest.get("schema") in (TRACE_SCHEMA_V2, TRACE_SCHEMA):
+    if manifest.get("schema") in (TRACE_SCHEMA_V2, TRACE_SCHEMA_V3,
+                                  TRACE_SCHEMA):
         # v2+: mode + pull geometry are mandatory; pull arrays exist
         # exactly when the mode has a pull phase
         mode = manifest.get("gossip_mode")
-        if mode not in ("push", "pull", "push-pull"):
+        if mode not in ("push", "pull", "push-pull", "adaptive"):
             problems.append(f"v2 manifest: bad gossip_mode {mode!r}")
         if not isinstance(manifest.get("pull_slots"), int):
             problems.append("v2 manifest: pull_slots missing or not int")
-        if mode in ("pull", "push-pull") and not is_traffic:
+        if mode in ("pull", "push-pull", "adaptive") and not is_traffic:
             for name in PULL_ARRAY_SPECS:
                 if name not in (manifest.get("arrays") or {}):
                     problems.append(f"pull arrays entry missing: {name}")
-    if manifest.get("schema") == TRACE_SCHEMA and is_traffic:
-        # v3 traffic manifests: the value-id column is mandatory
+    if (manifest.get("schema") in (TRACE_SCHEMA_V3, TRACE_SCHEMA)
+            and is_traffic):
+        # v3+ traffic manifests: the value-id column is mandatory
         for name in ("value_id", "value_origin"):
             if name not in (manifest.get("arrays") or {}):
                 problems.append(f"traffic arrays entry missing: {name}")
+    if manifest.get("schema") == TRACE_SCHEMA:
+        # v4: adaptive manifests carry the switch-event arrays
+        if manifest.get("gossip_mode") == "adaptive":
+            need = (TRAFFIC_ADAPTIVE_ARRAY_SPECS if is_traffic
+                    else ADAPTIVE_ARRAY_SPECS)
+            for name in need:
+                if name not in (manifest.get("arrays") or {}):
+                    problems.append(
+                        f"adaptive arrays entry missing: {name}")
     for seg in manifest.get("segments") or []:
         if (not isinstance(seg, dict) or "file" not in seg
                 or "start_round" not in seg or "end_round" not in seg):

@@ -55,6 +55,8 @@ def state_shardings(mesh: Mesh, shard_nodes: bool = True) -> dict:
         # the node axis like hops_hist_acc, rescue counts shard with it
         "pull_hops_hist_acc": P("origins"),
         "pull_rescued_acc": P("origins", n),
+        # adaptive direction bit (adaptive.py): [O], per-origin-sim
+        "adaptive_pull_on": P("origins"),
     }
 
 

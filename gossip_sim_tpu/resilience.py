@@ -371,6 +371,10 @@ def restore_stats(payload: dict, config, stakes):
     stats.pull_dropped_stats.collection = list(snap["pull_dropped"])
     stats.pull_suppressed_stats.collection = list(snap["pull_suppressed"])
     stats.pull_rescued_stats.collection = list(snap["pull_rescued"])
+    # adaptive direction-switch series (adaptive.py); absent in journals
+    # written before the adaptive mode existed
+    stats.adaptive_active_series = list(snap.get("adaptive_active", []))
+    stats.adaptive_switched_series = list(snap.get("adaptive_switched", []))
     stats.recovery_iterations = snap["recovery_iterations"]
     stats._post_heal_coverage = [(int(it), float(cov))
                                  for it, cov in payload.get("post_heal", [])]

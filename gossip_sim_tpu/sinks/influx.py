@@ -355,6 +355,21 @@ class InfluxDataPoint:
             f"start_time={self.start_timestamp} " + ",".join(parts) + " ")
         self.append_timestamp()
 
+    def create_sim_adaptive_point(self, it, values: dict):
+        """Adaptive push-pull series (adaptive.py): one point per measured
+        round with the direction-switch picture — on the traffic path the
+        stats.traffic ADAPTIVE_ROUND_FIELDS ints (pull-rescue message
+        counts, values in pull phase, switch events); on the single-origin
+        path the 0/1 direction bit + switch flag.  Deterministic — the
+        wire line joins the parity-snapshot surface the smoke gates
+        diff."""
+        fields = ",".join(f"{k}={int(v)}" for k, v in sorted(values.items()))
+        self.datapoint += (
+            f"sim_adaptive,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"iteration={int(it)},{fields} ")
+        self.append_timestamp()
+
     def create_messages_point(self, messages_direction: str, messages,
                               simulation_iter_val: int):
         for bucket, count in messages.items():

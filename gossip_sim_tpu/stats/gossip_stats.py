@@ -76,6 +76,12 @@ class GossipStats:
         self.pull_suppressed_stats = StatCollection(
             "Pull Suppressed Requests")
         self.pull_rescued_stats = StatCollection("Pull Rescued Nodes")
+        # adaptive direction-switch series (adaptive.py); empty unless
+        # gossip_mode "adaptive" ran.  active is the 0/1 direction bit in
+        # effect each measured round, switched flags the rounds whose
+        # coverage flipped it
+        self.adaptive_active_series = []
+        self.adaptive_switched_series = []
         # iterations from heal_at until coverage regained the recovery
         # threshold; None = no heal configured or never measured, -1 = never
         # recovered within the run
@@ -144,6 +150,8 @@ class GossipStats:
             "pull_dropped": list(self.pull_dropped_stats.collection),
             "pull_suppressed": list(self.pull_suppressed_stats.collection),
             "pull_rescued": list(self.pull_rescued_stats.collection),
+            "adaptive_active": list(self.adaptive_active_series),
+            "adaptive_switched": list(self.adaptive_switched_series),
             "recovery_iterations": self.recovery_iterations,
         }
 
@@ -207,6 +215,14 @@ class GossipStats:
 
     def has_pull_stats(self):
         return not self.pull_requests_stats.is_empty()
+
+    def insert_adaptive(self, active, switched):
+        """Per-round adaptive direction-switch telemetry (adaptive.py)."""
+        self.adaptive_active_series.append(int(active))
+        self.adaptive_switched_series.append(int(switched))
+
+    def has_adaptive_stats(self):
+        return bool(self.adaptive_active_series)
 
     def note_post_heal_coverage(self, it, coverage):
         """Record one post-heal (iteration, coverage) sample.  Both backends

@@ -25,9 +25,22 @@ ROUND_FIELDS = [
     "converged", "hop_clamped", "qdepth_max", "inflow_max",
 ]
 
-#: per-value retirement record keys (traffic.retire_record)
+#: per-value retirement record keys (traffic.retire_record); the last
+#: three are the starvation root-causing fields (ISSUE 11) — every record
+#: carries an explicit terminal cause plus its rescue/queue-drop evidence
 RECORD_FIELDS = ["vid", "origin", "birth", "retired_at", "latency_rounds",
-                 "holders", "coverage", "m", "rmr", "converged", "mean_hop"]
+                 "holders", "coverage", "m", "rmr", "converged", "mean_hop",
+                 "rescued_by_pull", "qdrops", "cause"]
+
+#: the per-round adaptive pull-rescue series (engine rows / TrafficRound
+#: fields share these names; fed only under gossip_mode "adaptive" and
+#: emitted as the ``sim_adaptive`` Influx series)
+ADAPTIVE_ROUND_FIELDS = [
+    "pull_sent", "pull_deferred", "pull_failed_target", "pull_suppressed",
+    "pull_dropped", "pull_arrived", "pull_queue_dropped", "pull_served",
+    "pull_responses", "pull_rescued", "pull_active_values",
+    "switched_to_pull",
+]
 
 
 class TrafficStats:
@@ -35,6 +48,7 @@ class TrafficStats:
 
     def __init__(self):
         self.rounds = {k: [] for k in ROUND_FIELDS}
+        self.adaptive_rounds = {k: [] for k in ADAPTIVE_ROUND_FIELDS}
         self.iterations = []
         self.records = []          # retirement record dicts, vid order
         self.final = {}            # end-of-run accumulator summary
@@ -45,6 +59,10 @@ class TrafficStats:
         self.iterations.append(int(it))
         for k in ROUND_FIELDS:
             self.rounds[k].append(int(values[k]))
+        if "pull_sent" in values:
+            # adaptive mode: the pull-rescue series rides along
+            for k in ADAPTIVE_ROUND_FIELDS:
+                self.adaptive_rounds[k].append(int(values[k]))
 
     def feed_records(self, records) -> None:
         self.records.extend(records)
@@ -65,14 +83,20 @@ class TrafficStats:
     def parity_snapshot(self) -> dict:
         """Every deterministic series/record as one dict — the traffic
         twin of GossipStats.parity_snapshot (one definition of the
-        bit-exactness surface; tools/traffic_smoke.py diffs it)."""
-        return {
+        bit-exactness surface; tools/traffic_smoke.py diffs it).  The
+        adaptive series appears only when it was fed (mode "adaptive"),
+        so push-mode snapshots keep their pre-adaptive shape."""
+        snap = {
             "iterations": list(self.iterations),
             "rounds": {k: list(v) for k, v in self.rounds.items()},
             "records": [
                 {f: rec[f] for f in RECORD_FIELDS} for rec in self.records],
             "final": dict(self.final),
         }
+        if any(self.adaptive_rounds.values()):
+            snap["adaptive_rounds"] = {
+                k: list(v) for k, v in self.adaptive_rounds.items()}
+        return snap
 
     def state_dict(self) -> dict:
         return self.parity_snapshot()
@@ -81,7 +105,18 @@ class TrafficStats:
         self.iterations = [int(x) for x in d.get("iterations", [])]
         self.rounds = {k: [int(x) for x in d.get("rounds", {}).get(k, [])]
                        for k in ROUND_FIELDS}
-        self.records = [dict(r) for r in d.get("records", [])]
+        self.adaptive_rounds = {
+            k: [int(x) for x in d.get("adaptive_rounds", {}).get(k, [])]
+            for k in ADAPTIVE_ROUND_FIELDS}
+        self.records = []
+        for r in d.get("records", []):
+            rec = dict(r)
+            # pre-v7 checkpoints: records predate the root-causing fields
+            rec.setdefault("rescued_by_pull", 0)
+            rec.setdefault("qdrops", 0)
+            rec.setdefault("cause", "converged" if rec.get("converged")
+                           else "stalled")
+            self.records.append(rec)
         self.final = dict(d.get("final", {}))
 
     def to_json(self) -> str:
@@ -102,12 +137,21 @@ class TrafficStats:
                          "delivered", "redundant", "accepted",
                          "prunes_sent", "retired", "converged",
                          "hop_clamped")}
+        causes = [r.get("cause") for r in recs]
         out = {
             "measured_rounds": len(self.iterations),
             "values_injected": tot["injected"],
             "values_retired": tot["retired"],
             "values_converged": tot["converged"],
             "values_stranded": tot["retired"] - tot["converged"],
+            # terminal-cause attribution (traffic.terminal_cause): every
+            # retired value is exactly one of converged / rescued_by_pull
+            # / starved_queue_drop / stalled
+            "values_rescued": causes.count("rescued_by_pull"),
+            "values_starved_queue_drop": causes.count("starved_queue_drop"),
+            "values_stalled": causes.count("stalled"),
+            "nodes_rescued": int(sum(r.get("rescued_by_pull", 0)
+                                     for r in recs)),
             "values_unfinished": int(self.final.get("live_at_end", 0)),
             "inject_dropped": tot["inject_dropped"],
             "sends": tot["sends"],
@@ -123,6 +167,14 @@ class TrafficStats:
             "inflow_max": int(max(self.rounds["inflow_max"], default=0)),
             "live_max": int(max(self.rounds["live"], default=0)),
         }
+        if any(self.adaptive_rounds.values()):
+            # adaptive pull-rescue totals (sim_adaptive series aggregate)
+            out.update({f"adaptive_{k}": int(np.sum(self.adaptive_rounds[k],
+                                                    dtype=np.int64))
+                        for k in ("pull_sent", "pull_responses",
+                                  "pull_rescued", "pull_deferred",
+                                  "pull_queue_dropped",
+                                  "switched_to_pull")})
         if len(recs):
             out.update({
                 "value_latency_mean": float(lat.mean()),

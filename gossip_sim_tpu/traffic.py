@@ -70,10 +70,12 @@ from typing import NamedTuple
 
 import numpy as np
 
+from .adaptive import (SALT_ADAPT_PBLOOM, SALT_ADAPT_PCLASS,
+                       SALT_ADAPT_PLOSS, SALT_ADAPT_PMEMBER, switch_update)
 from .faults import (_GOLD, edge_u32, edge_u32_arr, fmix32, fmix32_arr,
-                     node_u32_arr, partition_active, rate_threshold,
+                     node_u32, node_u32_arr, partition_active, rate_threshold,
                      round_basis, stake_bipartition)
-from .pull import PullTables, pull_class_tables
+from .pull import PullTables, pull_class_tables, u01_from_u32
 
 # domain-separation salts for the traffic hash streams (faults.py
 # convention; SHA-256 round constants, distinct from every existing salt)
@@ -243,14 +245,51 @@ class TrafficRound(NamedTuple):
     records: list            # retirement record dicts (see retire_record)
     node_deferred: np.ndarray      # [N] i64 deferrals per sender
     node_queue_dropped: np.ndarray  # [N] i64 ingress drops per receiver
+    # adaptive pull-rescue counters (adaptive.py; all zero outside
+    # gossip_mode="adaptive" — trailing defaults keep push-mode rounds
+    # constructing exactly as before)
+    pull_sent: int = 0           # rescue requests put on the wire
+    pull_deferred: int = 0       # requests deferred by node_egress_cap
+    pull_failed_target: int = 0  # requests into churn-failed peers
+    pull_suppressed: int = 0     # partition-suppressed requests
+    pull_dropped: int = 0        # loss-dropped requests
+    pull_arrived: int = 0        # requests that reached a live peer
+    pull_queue_dropped: int = 0  # arrivals dropped by node_ingress_cap
+    pull_served: int = 0         # arrivals accepted into the peer's budget
+    pull_responses: int = 0      # value transfers back to requesters
+    pull_rescued: int = 0        # first deliveries via pull this round
+    pull_active_values: int = 0  # live values in their pull phase
+    switched_to_pull: int = 0    # values flipping push -> pull this round
+
+
+#: terminal causes a retirement record carries (the starvation
+#: root-causing contract: every retired value says WHY it retired)
+CAUSE_CONVERGED = "converged"              # full coverage, push alone
+CAUSE_RESCUED_BY_PULL = "rescued_by_pull"  # full coverage, pull finished it
+CAUSE_STARVED_QUEUE_DROP = "starved_queue_drop"  # stalled with queue drops
+CAUSE_STALLED = "stalled"                  # stalled, no queue drop involved
+
+
+def terminal_cause(full: bool, rescued: int, qdrops: int) -> str:
+    """The explicit terminal cause of a retired value.  A converged value
+    that needed pull deliveries retires ``rescued_by_pull``; an
+    unconverged one whose messages hit an ingress queue drop retires
+    ``starved_queue_drop`` (the BENCH_r07 failure mode), else plain
+    ``stalled``."""
+    if full:
+        return CAUSE_RESCUED_BY_PULL if rescued > 0 else CAUSE_CONVERGED
+    return CAUSE_STARVED_QUEUE_DROP if qdrops > 0 else CAUSE_STALLED
 
 
 def retire_record(vid, origin, birth, it, holders, n, m_msgs, full,
-                  hops_sum) -> dict:
+                  hops_sum, rescued=0, qdrops=0) -> dict:
     """The per-value retirement record both backends emit (and the stats
     layer, Influx series, and run report consume).  ``latency_rounds``
     counts rounds in flight inclusive of the injection round; RMR follows
-    the push path's ``m/(n-1) - 1`` with m = accepted messages + prunes."""
+    the push path's ``m/(n-1) - 1`` with m = accepted messages + prunes.
+    ``rescued``/``qdrops`` root-cause the terminal state: pull-rescue
+    deliveries the value received (adaptive.py) and ingress queue drops
+    that hit its messages."""
     holders = int(holders)
     return {
         "vid": int(vid),
@@ -264,6 +303,9 @@ def retire_record(vid, origin, birth, it, holders, n, m_msgs, full,
         "rmr": (m_msgs / (holders - 1) - 1.0) if holders > 1 else 0.0,
         "converged": bool(full),
         "mean_hop": (hops_sum / holders) if holders > 0 else 0.0,
+        "rescued_by_pull": int(rescued),
+        "qdrops": int(qdrops),
+        "cause": terminal_cause(bool(full), int(rescued), int(qdrops)),
     }
 
 
@@ -295,7 +337,12 @@ class TrafficOracle:
                  packet_loss_rate: float = 0.0,
                  churn_fail_rate: float = 0.0,
                  churn_recover_rate: float = 0.0,
-                 partition_at: int = -1, heal_at: int = -1):
+                 partition_at: int = -1, heal_at: int = -1,
+                 gossip_mode: str = "push",
+                 adaptive_switch_threshold: float = 0.9,
+                 adaptive_switch_hysteresis: float = 0.05,
+                 pull_fanout: int = 2, pull_slots: int = 0,
+                 pull_bloom_fp_rate: float = 0.1):
         stakes = np.asarray(stakes, dtype=np.int64)
         self.stakes = stakes
         self.n = int(stakes.shape[0])
@@ -325,6 +372,14 @@ class TrafficOracle:
         self.heal_at = int(heal_at)
         self.side = (stake_bipartition(stakes)
                      if self.partition_at >= 0 else None)
+        # adaptive pull-rescue (adaptive.py); inert outside mode adaptive
+        self.adaptive = gossip_mode == "adaptive"
+        self.adapt_thr = float(adaptive_switch_threshold)
+        self.adapt_hyst = float(adaptive_switch_hysteresis)
+        self.pull_fanout = int(pull_fanout)
+        self.pull_slots = (int(pull_slots) if pull_slots > 0
+                           else max(8, self.pull_fanout))
+        self.pull_fp_thr = rate_threshold(pull_bloom_fp_rate)
 
         self.active = build_shared_active_set(stakes, self.seed, self.s,
                                               init_draws)
@@ -347,6 +402,10 @@ class TrafficOracle:
             # received cache: per node, {src: [score, stake]} + upserts
             "rc": [dict() for _ in range(self.n)],
             "rc_upserts": np.zeros(self.n, np.int32),
+            # adaptive direction state + starvation root-cause counters
+            "pull": False,     # value is in its pull-rescue phase
+            "rescued": 0,      # nodes delivered via pull rescue
+            "qdrop": 0,        # ingress queue drops that hit this value
         }
 
     # -- the round --------------------------------------------------------
@@ -401,9 +460,16 @@ class TrafficOracle:
         node_deferred = np.zeros(n, np.int64)
         node_qdrop = np.zeros(n, np.int64)
         sends = deferred = failed_target = suppressed = dropped = 0
+        pull_active_values = sum(
+            1 for m in live_slots if self.slots[m]["pull"])
         arrivals = []   # (value-slot m, src, fanout-slot, dst) in order
         for m in live_slots:
             v = self.slots[m]
+            if v["pull"]:
+                # adaptive direction flip: a pull-phase value generates NO
+                # push candidates — its bandwidth share moves to the
+                # rescue requests of the nodes still missing it
+                continue
             vb = value_basis(b_loss, v["vid"])
             for src in range(n):
                 if not v["holder"][src] or self.failed[src]:
@@ -445,9 +511,91 @@ class TrafficOracle:
             if 0 < self.ingress_cap <= ingress_used[dst]:
                 queue_dropped += 1
                 node_qdrop[dst] += 1
+                self.slots[m]["qdrop"] += 1
                 continue
             ingress_used[dst] += 1
             accepted.append((m, src, dst))
+
+        # ---- adaptive pull-rescue phase (adaptive.py) -------------------
+        # Per pull-phase value, every live node still missing it sends
+        # pull_fanout stake-weighted requests.  Requests continue the SAME
+        # egress/ingress budgets the push phase just consumed (value-major
+        # order after all push messages), so rescues pay for bandwidth
+        # honestly; a holder answers an accepted request unless the
+        # requester's per-value bloom digest false-positives.  Responses
+        # ride the reverse path of an accepted request (documented
+        # simplification: they do not re-enter the queue ranking) and the
+        # requester keeps the minimum (hop, clamp, peer) response.
+        pull_sent = pull_deferred = pull_failed_target = 0
+        pull_suppressed = pull_dropped = pull_arrived = 0
+        pull_qdropped = pull_served = pull_responses = 0
+        pull_rescues = {}   # (m, dst) -> (clamped hop, clamp bit, peer)
+        if self.adaptive and pull_active_values:
+            b_pc = round_basis(self.impair_seed, it, SALT_ADAPT_PCLASS)
+            b_pm = round_basis(self.impair_seed, it, SALT_ADAPT_PMEMBER)
+            b_pl = round_basis(self.impair_seed, it, SALT_ADAPT_PLOSS)
+            b_pb = round_basis(self.impair_seed, it, SALT_ADAPT_PBLOOM)
+            preq = []   # (m, requester, slot, peer, fp) in arrival order
+            for m in live_slots:
+                v = self.slots[m]
+                if not v["pull"]:
+                    continue
+                vid = v["vid"]
+                vb_c = value_basis(b_pc, vid)
+                vb_m = value_basis(b_pm, vid)
+                vb_l = value_basis(b_pl, vid)
+                vb_b = value_basis(b_pb, vid)
+                for d in range(n):
+                    if self.failed[d] or v["holder"][d]:
+                        continue
+                    fp_d = bool(self.pull_fp_thr
+                                and node_u32(vb_b, d) < self.pull_fp_thr)
+                    for s in range(min(self.pull_fanout, self.pull_slots)):
+                        peer = int(class_draw_arr(
+                            self.tables,
+                            np.asarray([u01_from_u32(edge_u32(vb_c, d, s))],
+                                       np.float32),
+                            np.asarray([u01_from_u32(edge_u32(vb_m, d, s))],
+                                       np.float32))[0])
+                        if peer == d:
+                            continue   # self-draw: slot discarded
+                        if 0 < self.egress_cap <= egress_used[d]:
+                            pull_deferred += 1
+                            node_deferred[d] += 1
+                            continue
+                        egress_used[d] += 1
+                        pull_sent += 1
+                        if self.failed[peer]:
+                            pull_failed_target += 1
+                            continue
+                        if part_on and self.side[d] != self.side[peer]:
+                            pull_suppressed += 1
+                            continue
+                        if (self.loss_thr
+                                and edge_u32(vb_l, d, peer) < self.loss_thr):
+                            pull_dropped += 1
+                            continue
+                        pull_arrived += 1
+                        preq.append((m, d, peer, fp_d))
+            for (m, d, peer, fp_d) in preq:
+                if 0 < self.ingress_cap <= ingress_used[peer]:
+                    pull_qdropped += 1
+                    node_qdrop[peer] += 1
+                    self.slots[m]["qdrop"] += 1
+                    continue
+                ingress_used[peer] += 1
+                pull_served += 1
+                v = self.slots[m]
+                v["m"] += 1
+                if v["holder"][peer] and not fp_d:
+                    pull_responses += 1
+                    v["m"] += 1
+                    th = int(v["hop"][peer]) + 1
+                    key = (min(th, self.hist_bins - 1),
+                           1 if th > self.hist_bins - 1 else 0, peer)
+                    cur = pull_rescues.get((m, d))
+                    if cur is None or key < cur:
+                        pull_rescues[(m, d)] = key
 
         # ---- per-value inbound ranking, delivery, rc merge, prunes ------
         h_clamp = self.hist_bins - 1
@@ -496,6 +644,20 @@ class TrafficOracle:
         # accepted copy (same-round duplicates included) is redundant
         delivered = len(new_hops)
         redundant = n_accepted - delivered
+        # pull-rescue deliveries apply after push deliveries (one
+        # request/response exchange per round, no intra-round cascade)
+        pull_rescued_cnt = 0
+        for (m, dst), (ch, clamp, _peer) in pull_rescues.items():
+            v = self.slots[m]
+            if v["holder"][dst]:
+                continue
+            v["holder"][dst] = True
+            v["hop"][dst] = ch
+            v["rescued"] += 1
+            pull_rescued_cnt += 1
+            progress[m] += 1
+            if clamp:
+                hop_clamped += 1
 
         # ---- prune decide + apply (per value, engine verbs 3-4) ---------
         for m in live_slots:
@@ -576,11 +738,25 @@ class TrafficOracle:
                 records.append(retire_record(
                     v["vid"], v["origin"], v["birth"], it, holders, n,
                     v["m"], full,
-                    int(v["hop"][v["holder"]].sum())))
+                    int(v["hop"][v["holder"]].sum()),
+                    rescued=v["rescued"], qdrops=v["qdrop"]))
                 retired += 1
                 converged += int(full)
                 self.slots[m] = None
         live = sum(sl is not None for sl in self.slots)
+
+        # ---- adaptive direction switch (end of round, survivors only) ---
+        switched = 0
+        if self.adaptive:
+            for m in range(self.mv):
+                v = self.slots[m]
+                if v is None:
+                    continue
+                new_on = switch_update(int(v["holder"].sum()), n, v["pull"],
+                                       self.adapt_thr, self.adapt_hyst)
+                if new_on and not v["pull"]:
+                    switched += 1
+                v["pull"] = new_on
 
         return TrafficRound(
             injected=injected, inject_dropped=inject_dropped, live=live,
@@ -593,4 +769,12 @@ class TrafficOracle:
             qdepth_max=int(node_deferred.max()) if n else 0,
             inflow_max=int(ingress_used.max()) if n else 0,
             records=records, node_deferred=node_deferred,
-            node_queue_dropped=node_qdrop)
+            node_queue_dropped=node_qdrop,
+            pull_sent=pull_sent, pull_deferred=pull_deferred,
+            pull_failed_target=pull_failed_target,
+            pull_suppressed=pull_suppressed, pull_dropped=pull_dropped,
+            pull_arrived=pull_arrived, pull_queue_dropped=pull_qdropped,
+            pull_served=pull_served, pull_responses=pull_responses,
+            pull_rescued=pull_rescued_cnt,
+            pull_active_values=pull_active_values,
+            switched_to_pull=switched)

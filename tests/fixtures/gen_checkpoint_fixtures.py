@@ -1,14 +1,15 @@
-"""Generator for the committed v1-v5 checkpoint fixtures (run once).
+"""Generator for the committed v1-v7 checkpoint fixtures (run once).
 
 The fixtures pin the forward-compat contract: every checkpoint format the
 project ever shipped must stay loadable by ``load_state`` /
 ``restore_sim_state`` forever (tests/test_checkpoint.py matrix).  They
 are COMMITTED BINARIES — regenerating them with a newer engine would
 defeat the point, so this script exists only to document how they were
-made (v1-v4: v5-era engine, 2026-08; v5: v6-era engine, 2026-08 — the
-SimState array set and the 16-node fixture dynamics are unchanged between
-those eras, so the file is byte-faithful to what a v5 writer produced)
-and to rebuild them if the fixture cluster spec itself ever has to change
+made (v1-v4: v5-era engine, 2026-08; v5: v6-era engine, 2026-08; v6: the
+v7-era engine, 2026-08, with the adaptive direction bit stripped — the
+push-mode fixture dynamics are bit-identical between those eras, so each
+file is byte-faithful to what its own era's writer produced) and to
+rebuild them if the fixture cluster spec itself ever has to change
 (requires re-validating against the old loaders).  Existing fixture files
 are never overwritten — delete one explicitly to regenerate it.
 
@@ -37,9 +38,10 @@ HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "checkpoints")
 
 # fields each era's SimState did NOT yet have
+PRE_V7_MISSING = ("adaptive_pull_on",)
 V1_MISSING = ("tfail", "rc_shi", "rc_slo",
-              "pull_hops_hist_acc", "pull_rescued_acc")
-PRE_V4_MISSING = ("pull_hops_hist_acc", "pull_rescued_acc")
+              "pull_hops_hist_acc", "pull_rescued_acc") + PRE_V7_MISSING
+PRE_V4_MISSING = ("pull_hops_hist_acc", "pull_rescued_acc") + PRE_V7_MISSING
 IMPAIR_KEYS = ("packet_loss_rate", "churn_fail_rate", "churn_recover_rate",
                "partition_at", "heal_at", "impair_seed")
 PULL_KEYS = ("gossip_mode", "pull_fanout", "pull_interval",
@@ -47,6 +49,8 @@ PULL_KEYS = ("gossip_mode", "pull_fanout", "pull_interval",
 # v6 (concurrent traffic) params that did not exist in the v5 era
 TRAFFIC_KEYS = ("traffic_values", "traffic_rate", "node_ingress_cap",
                 "node_egress_cap", "traffic_stall_rounds")
+# v7 (adaptive push-pull) params that did not exist in the v6 era
+ADAPTIVE_KEYS = ("adaptive_switch_threshold", "adaptive_switch_hysteresis")
 
 
 def main():
@@ -86,15 +90,32 @@ def main():
 
     impair = {k: pdict[k] for k in IMPAIR_KEYS}
     pull = {k: pdict[k] for k in PULL_KEYS if k != "pull_slots"}
-    write(1, V1_MISSING, IMPAIR_KEYS + PULL_KEYS + TRAFFIC_KEYS, {})
-    write(2, PRE_V4_MISSING, IMPAIR_KEYS + PULL_KEYS + TRAFFIC_KEYS, {})
-    write(3, PRE_V4_MISSING, PULL_KEYS + TRAFFIC_KEYS, {"impair": impair})
-    write(4, (), TRAFFIC_KEYS, {"impair": impair, "pull": pull})
+    traffic = {k: pdict[k] for k in TRAFFIC_KEYS}
+    old = ADAPTIVE_KEYS  # params no pre-v7 era ever wrote
+    write(1, V1_MISSING, IMPAIR_KEYS + PULL_KEYS + TRAFFIC_KEYS + old, {})
+    write(2, PRE_V4_MISSING, IMPAIR_KEYS + PULL_KEYS + TRAFFIC_KEYS + old,
+          {})
+    write(3, PRE_V4_MISSING, PULL_KEYS + TRAFFIC_KEYS + old,
+          {"impair": impair})
+    write(4, PRE_V7_MISSING, TRAFFIC_KEYS + old,
+          {"impair": impair, "pull": pull})
     # v5: same array set as v4 + the resilience meta block (PR 7); the
     # traffic params of the v6 era do not exist in a v5-era params dict
-    write(5, (), TRAFFIC_KEYS,
+    write(5, PRE_V7_MISSING, TRAFFIC_KEYS + old,
           {"impair": impair, "pull": pull,
            "resilience": {"journal": "", "committed_units": 0}})
+    # v6 (PR 8 era): traffic meta block + kind on every checkpoint; the
+    # adaptive direction bit / switch knobs of v7 do not exist yet
+    write(6, PRE_V7_MISSING, old,
+          {"impair": impair, "pull": pull, "traffic": traffic,
+           "resilience": {"journal": "", "committed_units": 0},
+           "kind": "sim"})
+    # v7 (current): the full array set + the adaptive meta block
+    write(7, (), (),
+          {"impair": impair, "pull": pull, "traffic": traffic,
+           "adaptive": {k: pdict[k] for k in ADAPTIVE_KEYS},
+           "resilience": {"journal": "", "committed_units": 0},
+           "kind": "sim"})
 
 
 if __name__ == "__main__":

@@ -1,0 +1,510 @@
+"""Adaptive push-pull subsystem tests (adaptive.py; ISSUE 11).
+
+Five contracts:
+
+* **Mode gating, zero bit-impact** — ``gossip_mode="push"`` and ``"pull"``
+  emit bit-identical rows/state whatever the adaptive knobs say (the
+  switch exists only in the adaptive graph), and adaptive mode itself
+  starts push-only (the direction bit is False until coverage crosses the
+  threshold).
+* **Switch semantics** — the direction bit activates one round after push
+  coverage crosses the threshold, the hysteresis window gates the flip
+  back, and gated rounds report the identical zero pull counters an
+  off-interval round does.
+* **Oracle parity** — at 1k nodes under packet loss AND churn the
+  sort-routed engine and the loop-based AdaptiveOracle agree bit-for-bit
+  on the direction bit, switch rounds, pull counters and rescue hops.
+* **Traffic composition** — per-value pull rescues in the traffic engine
+  are bit-exact vs TrafficOracle (counters, retirement records with
+  terminal causes) and actually rescue starved values.
+* **Compile-once / lanes** — an adaptive-threshold sweep reuses one
+  compiled executable and is lane-batchable with per-lane bit-parity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gossip_sim_tpu.adaptive import (AdaptiveOracle, switch_update,
+                                     switch_update_arr)
+from gossip_sim_tpu.constants import UNREACHED
+from gossip_sim_tpu.engine import (EngineParams, clear_compile_cache,
+                                   compiled_cache_size, init_state,
+                                   make_cluster_tables, run_rounds)
+from gossip_sim_tpu.identity import (NodeIndex, get_stake_bucket,
+                                     pubkey_new_unique)
+from gossip_sim_tpu.oracle.cluster import Cluster, Node
+
+
+def _stakes(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.choice(np.arange(1, 50 * n), size=n,
+                      replace=False).astype(np.int64) * 10**9
+
+
+def _run_engine(params, n, seed=3, rounds=6, **kw):
+    tables = make_cluster_tables(_stakes(n, seed))
+    origins = jnp.arange(1, dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(seed), tables, origins, params)
+    state, rows = run_rounds(params, tables, origins, state, rounds, **kw)
+    return state, jax.tree_util.tree_map(np.asarray, rows)
+
+
+# --------------------------------------------------------------------------
+# the switch rule itself
+# --------------------------------------------------------------------------
+
+class TestSwitchRule:
+    def test_threshold_and_hysteresis_band(self):
+        n = 1000
+        # crossing up at >= thr * n
+        assert switch_update(900, n, False, 0.9, 0.05)
+        assert not switch_update(899, n, False, 0.9, 0.05)
+        # inside the hysteresis band the bit holds its value
+        assert switch_update(870, n, True, 0.9, 0.05)
+        assert not switch_update(870, n, False, 0.9, 0.05)
+        # below thr - hyst it drops
+        assert not switch_update(849, n, True, 0.9, 0.05)
+
+    def test_array_and_scalar_paths_agree(self):
+        n = 777
+        counts = np.arange(0, n + 1, 7, dtype=np.int64)
+        for prev in (False, True):
+            arr = switch_update_arr(counts, n, np.full(counts.shape, prev),
+                                    0.83, 0.11)
+            scal = np.array([switch_update(int(c), n, prev, 0.83, 0.11)
+                             for c in counts])
+            np.testing.assert_array_equal(arr, scal)
+
+    def test_jnp_path_matches_numpy(self):
+        n = 500
+        counts = np.arange(0, n + 1, 13, dtype=np.int32)
+        prev = (counts % 2) == 0
+        a = switch_update_arr(counts, n, prev, 0.77, 0.07)
+        b = np.asarray(switch_update_arr(jnp.asarray(counts), n,
+                                         jnp.asarray(prev),
+                                         np.float64(0.77), np.float64(0.07),
+                                         jnp))
+        np.testing.assert_array_equal(a, b)
+
+
+# --------------------------------------------------------------------------
+# mode gating: zero bit-impact outside adaptive mode
+# --------------------------------------------------------------------------
+
+class TestModeGating:
+    N = 128
+
+    def test_push_mode_ignores_adaptive_knobs(self):
+        """mode=push with adaptive knobs set emits bit-identical rows and
+        state to the bare defaults — no switch exists in the graph."""
+        base = EngineParams(num_nodes=self.N, warm_up_rounds=0)
+        explicit = base._replace(adaptive_switch_threshold=0.3,
+                                 adaptive_switch_hysteresis=0.2)
+        s1, r1 = _run_engine(base, self.N, rounds=5, detail=True)
+        s2, r2 = _run_engine(explicit, self.N, rounds=5, detail=True)
+        assert set(r1) == set(r2)
+        assert "adaptive_pull_active" not in r1
+        for k in r1:
+            np.testing.assert_array_equal(r1[k], r2[k], err_msg=k)
+        for f in s1._fields:
+            np.testing.assert_array_equal(np.asarray(getattr(s1, f)),
+                                          np.asarray(getattr(s2, f)),
+                                          err_msg=f)
+        assert not np.asarray(s1.adaptive_pull_on).any()
+
+    def test_pull_modes_ignore_adaptive_knobs(self):
+        """Fixed pull modes carry no switch either: stepping the adaptive
+        knobs reuses the same executable and moves zero bits."""
+        base = EngineParams(num_nodes=self.N, warm_up_rounds=0,
+                            gossip_mode="push-pull", pull_fanout=3)
+        explicit = base._replace(adaptive_switch_threshold=0.2,
+                                 adaptive_switch_hysteresis=0.1)
+        assert base.static_part() == explicit.static_part()
+        _, r1 = _run_engine(base, self.N, rounds=4, detail=True)
+        _, r2 = _run_engine(explicit, self.N, rounds=4, detail=True)
+        for k in r1:
+            np.testing.assert_array_equal(r1[k], r2[k], err_msg=k)
+
+    def test_adaptive_mode_validation(self):
+        with pytest.raises(AssertionError):
+            EngineParams(num_nodes=16, gossip_mode="adaptive",
+                         adaptive_switch_threshold=0.0).validate()
+        with pytest.raises(AssertionError):
+            EngineParams(num_nodes=16, gossip_mode="adaptive",
+                         adaptive_switch_hysteresis=0.95).validate()
+        # traffic composes with push and adaptive, not fixed pull modes
+        EngineParams(num_nodes=16, traffic_values=4,
+                     gossip_mode="adaptive").validate()
+        with pytest.raises(AssertionError):
+            EngineParams(num_nodes=16, traffic_values=4,
+                         gossip_mode="push-pull").validate()
+        with pytest.raises(AssertionError):
+            EngineParams(num_nodes=16, traffic_values=4,
+                         gossip_mode="adaptive",
+                         node_ingress_cap=1 << 20).validate()
+
+
+# --------------------------------------------------------------------------
+# switch semantics in the single-origin engine
+# --------------------------------------------------------------------------
+
+class TestAdaptiveEngine:
+    N = 128
+
+    def test_first_round_is_push_only_then_switches(self):
+        """The direction bit starts False (round 0 is pure push); once the
+        round's push coverage crosses the threshold the pull phase runs
+        from the NEXT round on."""
+        p = EngineParams(num_nodes=self.N, warm_up_rounds=0,
+                         gossip_mode="adaptive",
+                         adaptive_switch_threshold=0.5,
+                         adaptive_switch_hysteresis=0.1).validate()
+        _, rows = _run_engine(p, self.N, rounds=5, detail=True)
+        act = rows["adaptive_pull_active"][:, 0].astype(int)
+        assert act[0] == 0
+        assert rows["pull_requests"][0, 0] == 0
+        # an unimpaired push run covers everything in round 0, so the bit
+        # is on (and pull runs) from round 1 onward
+        assert (act[1:] == 1).all()
+        assert (rows["pull_requests"][1:, 0] > 0).all()
+        assert rows["adaptive_switched"][0, 0] == 1
+
+    def test_gated_round_matches_interval_gated_round(self):
+        """A switch-gated pull round reports the identical zero counters
+        and -1 trace slots an off-interval pull round does."""
+        p = EngineParams(num_nodes=self.N, warm_up_rounds=0,
+                         gossip_mode="adaptive",
+                         adaptive_switch_threshold=0.5).validate()
+        _, rows = _run_engine(p, self.N, rounds=2, detail=True, trace=True)
+        # round 0 is gated by the direction bit
+        assert rows["pull_requests"][0, 0] == 0
+        assert (rows["trace_pull_peers"][0, 0] == -1).all()
+        assert (rows["trace_pull_code"][0, 0] == 0).all()
+        assert (rows["pull_hop"][0, 0] == -1).all()
+
+
+# --------------------------------------------------------------------------
+# 1k-node oracle-vs-engine bit-exact parity under loss + churn
+# --------------------------------------------------------------------------
+
+class TestAdaptiveParity:
+    """The acceptance gate: >= 1k nodes, shared seeds, forced-identical
+    active sets, rotation off, packet loss AND churn active, adaptive
+    mode — the direction bit, switch rounds, pull counters and rescue
+    hops must match the AdaptiveOracle bit-for-bit every round."""
+
+    N = 1024
+    ROUNDS = 6
+    SEED = 77
+    KNOBS = dict(packet_loss_rate=0.15, churn_fail_rate=0.02,
+                 churn_recover_rate=0.25)
+    PULL = dict(pull_fanout=3, pull_interval=1, pull_bloom_fp_rate=0.25,
+                pull_request_cap=3)
+    ADAPT = dict(adaptive_switch_threshold=0.9,
+                 adaptive_switch_hysteresis=0.05)
+
+    def test_exact_parity_adaptive_under_faults(self):
+        n = self.N
+        stakes_arr = _stakes(n, seed=23)
+        accounts = {pubkey_new_unique(): int(s) for s in stakes_arr}
+        index = NodeIndex.from_stakes(accounts)
+        stakes_np = index.stakes.astype(np.int64)
+
+        tables = make_cluster_tables(stakes_np)
+        params = EngineParams(num_nodes=n, probability_of_rotation=0.0,
+                              warm_up_rounds=0, impair_seed=self.SEED,
+                              gossip_mode="adaptive", **self.KNOBS,
+                              **self.PULL, **self.ADAPT).validate()
+        origins = jnp.asarray([0], jnp.int32)
+        state = init_state(jax.random.PRNGKey(13), tables, origins, params)
+
+        stakes_map = {pk: int(s) for pk, s in zip(index.pubkeys, stakes_np)}
+        nodes = [Node(pk, stakes_map[pk]) for pk in index.pubkeys]
+        origin_pk = index.pubkeys[0]
+        active = np.asarray(state.active[0])
+        for i, node in enumerate(nodes):
+            bucket = get_stake_bucket(min(stakes_map[node.pubkey],
+                                          stakes_map[origin_pk]))
+            entry = node.active_set.entries[bucket]
+            entry.peers = {index.pubkeys[j]: {index.pubkeys[j]}
+                           for j in active[i] if j < n}
+        node_map = {nd.pubkey: nd for nd in nodes}
+
+        from gossip_sim_tpu.faults import FaultInjector
+        cluster = Cluster(params.push_fanout)
+        impair = FaultInjector(index, seed=self.SEED, **self.KNOBS)
+        oracle = AdaptiveOracle(
+            stakes_np, seed=self.SEED,
+            pull_slots=params.pull_slots_resolved,
+            packet_loss_rate=self.KNOBS["packet_loss_rate"],
+            **self.PULL, **self.ADAPT)
+
+        state, rows = run_rounds(params, tables, origins, state,
+                                 self.ROUNDS, detail=True)
+        rows = jax.tree_util.tree_map(np.asarray, rows)
+
+        saw_on = saw_rescue = False
+        for r in range(self.ROUNDS):
+            impair.begin_round(r)
+            impair.churn_step(r, node_map, cluster.failed_nodes)
+            cluster.run_gossip(origin_pk, stakes_map, node_map, impair)
+            active_pre = bool(oracle.pull_active)
+            cluster.run_pull(oracle, r, index, node_map)
+            cluster.consume_messages(origin_pk, nodes)
+
+            assert int(rows["adaptive_pull_active"][r, 0]) == int(
+                active_pre), f"direction bit diverges at round {r}"
+            sw = oracle.switch_rounds
+            assert int(rows["adaptive_switched"][r, 0]) == int(
+                bool(sw) and sw[-1][0] == r), f"switch event at round {r}"
+
+            pr = cluster.pull
+            assert rows["pull_requests"][r, 0] == pr.requests, f"round {r}"
+            assert rows["pull_responses"][r, 0] == pr.responses, f"round {r}"
+            assert rows["pull_misses"][r, 0] == pr.misses, f"round {r}"
+            assert rows["pull_dropped"][r, 0] == pr.dropped, f"round {r}"
+            assert rows["pull_rescued"][r, 0] == len(pr.rescued), f"round {r}"
+            np.testing.assert_array_equal(
+                rows["pull_hop"][r, 0], pr.pull_hop.astype(np.int32),
+                err_msg=f"pull hops diverge at round {r}")
+
+            dist_o = np.array(
+                [-1 if cluster.distances[pk] == UNREACHED
+                 else cluster.distances[pk] for pk in index.pubkeys])
+            np.testing.assert_array_equal(
+                rows["dist"][r, 0], dist_o,
+                err_msg=f"push distances diverge at round {r}")
+
+            saw_on |= active_pre
+            saw_rescue |= len(pr.rescued) > 0
+            cluster.send_prunes(origin_pk, nodes,
+                                params.prune_stake_threshold,
+                                params.min_ingress_nodes, stakes_map)
+            cluster.prune_connections(node_map, stakes_map)
+
+        assert saw_on, "regime never flipped into the pull phase"
+        assert saw_rescue, "regime never exercised an adaptive rescue"
+
+
+# --------------------------------------------------------------------------
+# traffic composition: per-value pull rescues (engine vs TrafficOracle)
+# --------------------------------------------------------------------------
+
+ADAPTIVE_PARITY_FIELDS = [
+    "injected", "inject_dropped", "live", "sends", "deferred",
+    "failed_target", "suppressed", "dropped", "arrived", "queue_dropped",
+    "accepted", "delivered", "redundant", "prunes_sent", "retired",
+    "converged", "hop_clamped", "qdepth_max", "inflow_max",
+    "pull_sent", "pull_deferred", "pull_failed_target", "pull_suppressed",
+    "pull_dropped", "pull_arrived", "pull_queue_dropped", "pull_served",
+    "pull_responses", "pull_rescued", "pull_active_values",
+    "switched_to_pull",
+]
+
+
+class TestTrafficAdaptiveParity:
+    N = 120
+    ROUNDS = 30
+    KW = dict(traffic_values=6, traffic_rate=2, node_ingress_cap=24,
+              node_egress_cap=32, traffic_stall_rounds=4,
+              packet_loss_rate=0.1, churn_fail_rate=0.02,
+              churn_recover_rate=0.25)
+
+    def test_engine_matches_oracle_with_rescues(self):
+        from gossip_sim_tpu.engine.traffic import (device_traffic_tables,
+                                                   init_traffic_state,
+                                                   run_traffic_rounds)
+        from gossip_sim_tpu.traffic import TrafficOracle, retire_record
+
+        n = self.N
+        stakes = _stakes(n, seed=3)
+        p = EngineParams(num_nodes=n, warm_up_rounds=0,
+                         gossip_mode="adaptive", impair_seed=7,
+                         adaptive_switch_threshold=0.6,
+                         adaptive_switch_hysteresis=0.1,
+                         **self.KW).validate()
+        tables = make_cluster_tables(stakes)
+        tt = device_traffic_tables(stakes)
+        st = init_traffic_state(stakes, p, seed=11)
+        st, rows = run_traffic_rounds(p, tables, tt, st, self.ROUNDS)
+        rows = jax.tree_util.tree_map(np.asarray, rows)
+
+        orc = TrafficOracle(stakes, seed=11, impair_seed=7,
+                            gossip_mode="adaptive",
+                            adaptive_switch_threshold=0.6,
+                            adaptive_switch_hysteresis=0.1, **self.KW)
+        orecs = []
+        for it in range(self.ROUNDS):
+            tr = orc.run_round(it)
+            orecs.extend(tr.records)
+            for f in ADAPTIVE_PARITY_FIELDS:
+                assert int(rows[f][it]) == int(getattr(tr, f)), \
+                    f"round {it}: {f}"
+        erecs = []
+        for it in range(self.ROUNDS):
+            for m in np.nonzero(rows["ret_mask"][it])[0]:
+                g = lambda k: rows[k][it, m]
+                erecs.append(retire_record(
+                    int(g("ret_vid")), int(g("ret_origin")),
+                    int(g("ret_birth")), it, int(g("ret_holders")), n,
+                    int(g("ret_m")), bool(g("ret_full")),
+                    int(g("ret_hops_sum")), rescued=int(g("ret_rescued")),
+                    qdrops=int(g("ret_qdrop"))))
+        assert erecs == orecs
+        # the regime must actually exercise the healing path
+        assert sum(r["rescued_by_pull"] for r in orecs) > 0
+        assert any(r["cause"] == "rescued_by_pull" for r in orecs)
+        # pull-phase values stop pushing: switch events happened
+        assert rows["switched_to_pull"].sum() > 0
+
+    def test_push_traffic_unaffected_by_adaptive_knobs(self):
+        """mode=push traffic with adaptive knobs set is bit-identical to
+        the bare push traffic engine (same static key, no rescue code)."""
+        from gossip_sim_tpu.engine.traffic import (device_traffic_tables,
+                                                   init_traffic_state,
+                                                   run_traffic_rounds)
+        n = 100
+        stakes = _stakes(n, seed=5)
+        base = EngineParams(num_nodes=n, warm_up_rounds=0, impair_seed=2,
+                            **self.KW).validate()
+        knobbed = base._replace(adaptive_switch_threshold=0.1,
+                                adaptive_switch_hysteresis=0.05)
+        assert base.static_part() == knobbed.static_part()
+        tables = make_cluster_tables(stakes)
+        tt = device_traffic_tables(stakes)
+
+        def run(p):
+            st = init_traffic_state(stakes, p, seed=4)
+            st, rows = run_traffic_rounds(p, tables, tt, st, 8)
+            return st, jax.tree_util.tree_map(np.asarray, rows)
+
+        s1, r1 = run(base)
+        s2, r2 = run(knobbed)
+        assert set(r1) == set(r2)
+        assert "pull_sent" not in r1
+        for k in r1:
+            np.testing.assert_array_equal(r1[k], r2[k], err_msg=k)
+        for f in s1._fields:
+            np.testing.assert_array_equal(np.asarray(getattr(s1, f)),
+                                          np.asarray(getattr(s2, f)),
+                                          err_msg=f)
+        assert not np.asarray(s1.v_pull).any()
+
+
+# --------------------------------------------------------------------------
+# compile-once + lane parity for the threshold sweep
+# --------------------------------------------------------------------------
+
+class TestAdaptiveSweeps:
+    N = 96
+
+    def test_threshold_sweep_compiles_once(self):
+        p0 = EngineParams(num_nodes=self.N, warm_up_rounds=0,
+                          gossip_mode="adaptive",
+                          adaptive_switch_threshold=0.5).validate()
+        tables = make_cluster_tables(_stakes(self.N, 1))
+        origins = jnp.arange(1, dtype=jnp.int32)
+        clear_compile_cache()
+        state = init_state(jax.random.PRNGKey(0), tables, origins, p0)
+        state, _ = run_rounds(p0, tables, origins, state, 3)
+        base = compiled_cache_size()
+        for thr in (0.6, 0.75, 0.9):
+            p = p0._replace(adaptive_switch_threshold=thr)
+            state, rows = run_rounds(p, tables, origins, state, 3)
+        assert compiled_cache_size() == base, \
+            "threshold steps must reuse the compiled executable"
+
+    def test_lane_sweep_matches_serial(self):
+        from gossip_sim_tpu.engine import (broadcast_state, run_rounds_lanes,
+                                           stack_knobs)
+        thresholds = (0.4, 0.7, 0.95)
+        p0 = EngineParams(num_nodes=self.N, warm_up_rounds=0,
+                          gossip_mode="adaptive",
+                          adaptive_switch_hysteresis=0.1,
+                          packet_loss_rate=0.2, impair_seed=5).validate()
+        tables = make_cluster_tables(_stakes(self.N, 1))
+        origins = jnp.arange(1, dtype=jnp.int32)
+        init = init_state(jax.random.PRNGKey(2), tables, origins, p0)
+        static = p0.static_part()
+        params_k = [p0._replace(adaptive_switch_threshold=t)
+                    for t in thresholds]
+        lane_knobs = stack_knobs([p.knob_values() for p in params_k])
+        lstates, lrows = run_rounds_lanes(
+            static, tables, origins, broadcast_state(init, len(thresholds)),
+            lane_knobs, 5)
+        lrows = jax.tree_util.tree_map(np.asarray, lrows)
+        for lane, p in enumerate(params_k):
+            st = init_state(jax.random.PRNGKey(2), tables, origins, p)
+            st, rows = run_rounds(p, tables, origins, st, 5)
+            rows = jax.tree_util.tree_map(np.asarray, rows)
+            for k in ("coverage", "pull_requests", "pull_rescued",
+                      "adaptive_pull_active", "adaptive_switched", "m",
+                      "rmr"):
+                np.testing.assert_array_equal(
+                    rows[k], lrows[k][:, lane],
+                    err_msg=f"lane {lane} ({p.adaptive_switch_threshold}) "
+                            f"{k}")
+
+
+# --------------------------------------------------------------------------
+# checkpoint v7: adaptive state round-trips and resumes bit-exactly
+# --------------------------------------------------------------------------
+
+class TestAdaptiveCheckpoint:
+    def test_v7_traffic_adaptive_roundtrip_and_resume(self, tmp_path):
+        from gossip_sim_tpu.checkpoint import (restore_traffic_state,
+                                               save_traffic_state)
+        from gossip_sim_tpu.engine.traffic import (device_traffic_tables,
+                                                   init_traffic_state,
+                                                   run_traffic_rounds)
+
+        n = 64
+        stakes = _stakes(n, seed=9)
+        p = EngineParams(num_nodes=n, warm_up_rounds=0,
+                         gossip_mode="adaptive", traffic_values=4,
+                         traffic_rate=1, node_ingress_cap=16,
+                         adaptive_switch_threshold=0.5).validate()
+        tables = make_cluster_tables(stakes)
+        tt = device_traffic_tables(stakes)
+        st = init_traffic_state(stakes, p, seed=6)
+        st, _ = run_traffic_rounds(p, tables, tt, st, 6)
+        # save BEFORE the straight continuation: the runner donates its
+        # input state buffers
+        path = str(tmp_path / "adaptive.npz")
+        save_traffic_state(path, st, p, iteration=6)
+        straight, rows_a = run_traffic_rounds(p, tables, tt, st, 4,
+                                              start_it=6)
+        restored, _, meta = restore_traffic_state(path, p)
+        assert meta["format_version"] == 7
+        assert meta["adaptive"]["adaptive_switch_threshold"] == 0.5
+        resumed, rows_b = run_traffic_rounds(p, tables, tt, restored, 4,
+                                             start_it=6)
+        for f in straight._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(straight, f)),
+                np.asarray(getattr(resumed, f)), err_msg=f)
+        for k in rows_a:
+            np.testing.assert_array_equal(np.asarray(rows_a[k]),
+                                          np.asarray(rows_b[k]), err_msg=k)
+
+    def test_sim_checkpoint_carries_direction_bit(self, tmp_path):
+        from gossip_sim_tpu.checkpoint import (restore_sim_state,
+                                               save_state)
+
+        n = 64
+        p = EngineParams(num_nodes=n, warm_up_rounds=0,
+                         gossip_mode="adaptive",
+                         adaptive_switch_threshold=0.5).validate()
+        tables = make_cluster_tables(_stakes(n, 2))
+        origins = jnp.arange(1, dtype=jnp.int32)
+        st = init_state(jax.random.PRNGKey(1), tables, origins, p)
+        st, _ = run_rounds(p, tables, origins, st, 3)
+        assert np.asarray(st.adaptive_pull_on).any()
+        path = str(tmp_path / "sim.npz")
+        save_state(path, st, p, iteration=3)
+        restored, _, meta = restore_sim_state(path, p)
+        np.testing.assert_array_equal(np.asarray(restored.adaptive_pull_on),
+                                      np.asarray(st.adaptive_pull_on))
+        assert meta["adaptive"]["adaptive_switch_threshold"] == 0.5

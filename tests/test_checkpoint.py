@@ -113,7 +113,7 @@ def test_v3_checkpoint_records_impair_block(tmp_path):
     path = str(tmp_path / "ckpt.npz")
     save_state(path, state, params, iteration=4)
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 6
+    assert meta["format_version"] == 7
     assert meta["impair"] == {
         "packet_loss_rate": 0.25, "churn_fail_rate": 0.01,
         "churn_recover_rate": 0.5, "partition_at": 3, "heal_at": 8,
@@ -236,12 +236,13 @@ def test_impair_knob_mismatch_warns_on_resume(tmp_path, caplog):
 FIXTURE_DIR = __file__.rsplit("/", 1)[0] + "/fixtures/checkpoints"
 
 
-@pytest.mark.parametrize("version", [1, 2, 3, 4, 5])
+@pytest.mark.parametrize("version", [1, 2, 3, 4, 5, 6, 7])
 def test_checkpoint_forward_compat_matrix(version):
-    """Committed v1-v5 fixture files (tests/fixtures/checkpoints, frozen
+    """Committed v1-v7 fixture files (tests/fixtures/checkpoints, frozen
     binaries from each format era) must load and restore forever — a new
     format can never silently orphan old checkpoints (ISSUE 7; v5 joined
-    the matrix when checkpoint v6 landed, ISSUE 10).  Each fixture must
+    the matrix when checkpoint v6 landed, ISSUE 10; v6 when v7 landed,
+    ISSUE 11).  Each fixture must
     (a) pass load_state's validation against current EngineParams,
     (b) restore to a full SimState with the era-appropriate backfills,
     (c) continue running on the current engine."""
@@ -272,6 +273,9 @@ def test_checkpoint_forward_compat_matrix(version):
     assert meta["traffic"]["traffic_values"] == 1
     assert meta["traffic"]["node_ingress_cap"] == 0
     assert meta["kind"] == "sim"
+    # pre-v7 backfill: adaptive switch knobs at the engine defaults
+    assert meta["adaptive"]["adaptive_switch_threshold"] == \
+        EngineParams._field_defaults["adaptive_switch_threshold"]
 
     restored, _, _ = restore_sim_state(path, params, tables)
     for f in restored._fields:
@@ -282,6 +286,9 @@ def test_checkpoint_forward_compat_matrix(version):
     if version < 4:
         assert (np.asarray(restored.pull_hops_hist_acc) == 0).all()
         assert (np.asarray(restored.pull_rescued_acc) == 0).all()
+    if version < 7:
+        # the adaptive direction bit did not exist — exact zero backfill
+        assert not np.asarray(restored.adaptive_pull_on).any()
     # the restored state must continue on the current engine
     origins = jnp.arange(1, dtype=jnp.int32)
     state, rows = run_rounds(params, tables, origins, restored, 2,
@@ -296,7 +303,7 @@ def test_v5_checkpoint_records_resilience_block(tmp_path):
     save_state(path, state, params, iteration=2,
                resilience={"journal": "ckpt.journal", "committed_units": 3})
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 6
+    assert meta["format_version"] == 7
     assert meta["resilience"] == {"journal": "ckpt.journal",
                                   "committed_units": 3}
 
@@ -356,7 +363,7 @@ def test_v6_traffic_checkpoint_roundtrip_and_kind_guard(tmp_path):
                        traffic_stats=stats_state)
     restored, stored, meta = restore_traffic_state(path, tparams)
     assert meta["kind"] == "traffic"
-    assert meta["format_version"] == 6
+    assert meta["format_version"] == 7
     assert meta["traffic"]["traffic_values"] == 3
     assert meta["traffic_stats"]["iterations"] == [0, 1, 2]
     for f, a, b in zip(restored._fields, restored, tstate):

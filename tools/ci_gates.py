@@ -16,6 +16,10 @@ The gates, in dependency-light-first order:
                 bit-impact, 1k-node engine-vs-TrafficOracle parity under
                 loss+churn+queue caps, per-value coverage monotone in
                 the ingress cap
+  adaptive_smoke adaptive push-pull (ISSUE 11): converges >= 1 value on
+                the BENCH_r07 traffic config where push converges 0,
+                zero bit-impact at mode=push, 1k-node adaptive
+                engine-vs-oracle parity under loss+churn+caps
 
 Usage: python tools/ci_gates.py [--only NAME[,NAME...]]
 
@@ -30,7 +34,8 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
-         "pull_smoke", "lane_smoke", "resume_smoke", "traffic_smoke"]
+         "pull_smoke", "lane_smoke", "resume_smoke", "traffic_smoke",
+         "adaptive_smoke"]
 
 
 def main() -> int:

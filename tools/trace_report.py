@@ -133,22 +133,51 @@ def cmd_tree(args) -> int:
 
 def cmd_explain_stranded(args) -> int:
     tr = load_trace(args.trace_dir)
-    rnd, col = _round_and_col(tr, args)
-    origin = tr.origins[col]
-    s = _round_slice(tr, rnd, col)
-    # v2 pull traces: pass the pull hops so push-stranded nodes that a
-    # pull response rescued are tagged rescued_by_pull instead of stranded
-    explained = E.explain_stranded(s["active"], s["pruned"], s["peers"],
-                                   s["code"], s["dist"], s["failed"], origin,
-                                   pull_hop=s.get("pull_hop"))
+    is_traffic = int(tr.manifest.get("traffic_slots") or 0) > 0
+    vid = None
+    if is_traffic:
+        # traffic (v3+) traces: --col selects the VALUE SLOT; the shared
+        # active set + the slot's per-value arrays slice straight into
+        # explain_stranded, and (v4 adaptive) the slot's pull_hop column
+        # attributes this round's pull rescues to the value
+        rnd = args.round if args.round is not None else int(tr.rounds[-1])
+        at = tr.at(rnd)
+        v = args.col
+        V = int(tr.manifest["traffic_slots"])
+        if not 0 <= v < V:
+            raise SystemExit(f"--col {v} out of range (trace has {V} "
+                             f"value slot(s))")
+        vid = int(at["value_id"][v])
+        if vid < 0:
+            raise SystemExit(f"value slot {v} is free at round {rnd}; "
+                             f"pick a live slot (value_id >= 0)")
+        origin = int(at["value_origin"][v])
+        pull_hop = (at["pull_hop"][v] if "pull_hop" in at else None)
+        explained = E.explain_stranded(
+            at["active"], at["pruned"][v], at["peers"][v], at["code"][v],
+            at["dist"][v], at["failed"], origin, pull_hop=pull_hop)
+    else:
+        rnd, col = _round_and_col(tr, args)
+        origin = tr.origins[col]
+        s = _round_slice(tr, rnd, col)
+        # v2 pull traces: pass the pull hops so push-stranded nodes that a
+        # pull response rescued are tagged rescued_by_pull, not stranded
+        explained = E.explain_stranded(s["active"], s["pruned"], s["peers"],
+                                       s["code"], s["dist"], s["failed"],
+                                       origin, pull_hop=s.get("pull_hop"))
     if args.json:
-        print(json.dumps({"round": rnd, "origin": origin,
-                          "stranded": explained}, indent=2))
+        out = {"round": rnd, "origin": origin, "stranded": explained}
+        if vid is not None:
+            out["value_id"] = vid
+            out["value_slot"] = args.col
+        print(json.dumps(out, indent=2))
         return 0
     n_rescued = sum(1 for ent in explained
                     if E.CAUSE_RESCUED_BY_PULL in ent["summary"])
     tag = (f" ({n_rescued} rescued by pull)" if n_rescued else "")
-    print(f"stranded nodes: round {rnd}, origin {origin} -> "
+    what = (f"value {vid} (slot {args.col})" if vid is not None
+            else f"origin {origin}")
+    print(f"stranded nodes: round {rnd}, {what} -> "
           f"{len(explained) - n_rescued} stranded{tag}")
     for ent in explained:
         causes = ent["summary"]
@@ -290,7 +319,9 @@ def main(argv=None) -> int:
         p.add_argument("--round", type=int, default=None,
                        help="absolute round index (default: last traced)")
         p.add_argument("--col", type=int, default=0,
-                       help="origin column for multi-origin traces")
+                       help="origin column for multi-origin traces; for "
+                            "traffic traces (explain-stranded) the VALUE "
+                            "SLOT to analyze")
         p.add_argument("--json", action="store_true")
 
     common(sub.add_parser("info", help="manifest summary + validation"))
