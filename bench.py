@@ -49,7 +49,9 @@ LADDER = [
     (4_000, 16, 100, 600),
     (1_000, 8, 50, 420),
 ]
-CPU_RUNG = (1_000, 4, 20, 600)
+# 900 s: the rung ran 596 s of the old 600 s budget in BENCH_r08, and the
+# ISSUE-13 capacity harvest adds one extra XLA compile per executable
+CPU_RUNG = (1_000, 4, 20, 900)
 
 
 def _env_number(name, default, cast):
@@ -93,6 +95,45 @@ def worker(args) -> int:
                                        persistent_cache_counters,
                                        persistent_cache_dir, run_rounds)
     from gossip_sim_tpu.obs import bench_summary, get_registry
+    from gossip_sim_tpu.obs import capacity, memwatch
+
+    # capacity observatory (ISSUE 13): harvest XLA cost/memory analysis
+    # per compiled executable so every rung line carries a measured
+    # memory baseline (ROADMAP item 1's "memory-per-node tracked in
+    # BENCH").  The harvest's one extra AOT compile per executable fires
+    # INSIDE run_rounds (obs/capacity.py hook), i.e. inside this
+    # worker's timed sections — every timed figure below therefore
+    # subtracts the harvest-compile seconds accrued in its window
+    # (reg "capacity/harvest_compile"), so compile_s / elapsed_s /
+    # first-call numbers stay comparable with pre-harvest BENCH rounds.
+    capacity.reset_harvests()
+    capacity.set_harvest_enabled(True)
+
+    def harvest_s() -> float:
+        return reg.get("capacity/harvest_compile")
+
+    def deduct_harvest(span: str, h0: float) -> None:
+        """Remove harvest-compile seconds accrued since ``h0`` from a
+        span total (count 0: the call count stays honest)."""
+        dh = harvest_s() - h0
+        if dh > 0:
+            reg.record(span, -dh, count=0)
+
+    def rung_capacity(p, site, origin_batch=1, lanes=0):
+        """peak RSS + ledger bytes/node + the XLA temp/output bytes of
+        the executables harvested so far at ``site``."""
+        led = capacity.capacity_ledger(p, origin_batch=origin_batch,
+                                       lanes=lanes)
+        peaks = capacity.site_peaks(site)
+        return {
+            "peak_rss_bytes": memwatch.peak_rss_bytes(),
+            "mem_bytes_per_node": led["bytes_per_node"],
+            "ledger_total_bytes": led["total_bytes"],
+            "ledger_state_bytes": led["state_bytes"],
+            "xla_temp_bytes": peaks["temp_bytes"],
+            "xla_output_bytes": peaks["output_bytes"],
+            "xla_argument_bytes": peaks["argument_bytes"],
+        }
 
     # persistent XLA compilation cache (engine/cache.py): repeat BENCH runs
     # with GOSSIP_COMPILATION_CACHE set reuse the compiled round across
@@ -120,18 +161,24 @@ def worker(args) -> int:
         jax.block_until_ready(state)
 
     # compile + protocol warm-up (also brings the prune/rotate paths live)
+    h0 = harvest_s()
     with reg.span("engine/compile"):
         state, rows = run_rounds(params, tables, origins, state,
                                  args.warmup_timing)
         jax.block_until_ready(rows)
+    deduct_harvest("engine/compile", h0)
 
+    h0 = harvest_s()
     with reg.span("engine/rounds"):
         state, rows = run_rounds(params, tables, origins, state,
                                  args.iterations, start_it=args.warmup_timing)
         jax.block_until_ready(rows)
+    deduct_harvest("engine/rounds", h0)
     reg.add("origin_iters", o * args.iterations)
     coverage_mean = float(np.asarray(rows["coverage"]).mean())
     rmr_mean = float(np.asarray(rows["rmr"]).mean())
+    main_capacity = rung_capacity(params, "engine/run_rounds",
+                                  origin_batch=o)
 
     # ---- sweep rung: warm-executable sweep throughput ------------------
     # Steps a numeric EngineKnobs field per simulated point (the sweep
@@ -153,15 +200,18 @@ def worker(args) -> int:
     jax.block_until_ready(srows["coverage"])
     it_at += sweep_iters
     c_before = compiled_cache_size()
+    h0 = harvest_s()
     t_sweep = time.perf_counter()
     for k in range(1, sweep_steps + 1):
         state, srows = run_rounds(sweep_params(k), tables, origins, state,
                                   sweep_iters, start_it=it_at)
         jax.block_until_ready(srows["coverage"])
         it_at += sweep_iters
-    sweep_dt = time.perf_counter() - t_sweep
+    sweep_dt = time.perf_counter() - t_sweep - (harvest_s() - h0)
     sweep_compiles = (compiled_cache_size() - c_before
                       if c_before >= 0 else -1)
+    sweep_capacity = rung_capacity(params, "engine/run_rounds",
+                                   origin_batch=o)
 
     # ---- lane rung: the sweep axis as ONE batched device program -------
     # (engine/lanes.py, ISSUE 6).  Same per-point work as the serial sweep
@@ -177,20 +227,24 @@ def worker(args) -> int:
     static = params.static_part()
     lane_knobs = stack_knobs([sweep_params(k).knob_values()
                               for k in range(1, lanes + 1)])
+    h0 = harvest_s()
     t_lc = time.perf_counter()
     lstates, lrows = run_rounds_lanes(
         static, tables, origins, broadcast_state(state, lanes), lane_knobs,
         sweep_iters, start_it=it_at)
     jax.block_until_ready(lrows["coverage"])
-    lane_compile_dt = time.perf_counter() - t_lc
+    lane_compile_dt = time.perf_counter() - t_lc - (harvest_s() - h0)
     c_warm = lane_cache_size()
+    h0 = harvest_s()
     t_lane = time.perf_counter()
     lstates, lrows = run_rounds_lanes(
         static, tables, origins, broadcast_state(state, lanes), lane_knobs,
         sweep_iters, start_it=it_at)
     jax.block_until_ready(lrows["coverage"])
-    lane_dt = time.perf_counter() - t_lane
+    lane_dt = time.perf_counter() - t_lane - (harvest_s() - h0)
     lane_compiles = (lane_cache_size() - c_warm if c_warm >= 0 else -1)
+    lane_capacity = rung_capacity(params, "engine/run_rounds_lanes",
+                                  origin_batch=o, lanes=lanes)
 
     # ---- traffic rung: M concurrent values on one shared network -------
     # (traffic.py / engine/traffic.py, ISSUE 10).  M=64 in-flight values
@@ -214,21 +268,26 @@ def worker(args) -> int:
     tt = device_traffic_tables(tstakes)
     titers = max(5, min(20, args.iterations))
     tstate = init_traffic_state(tstakes, tparams, seed=0)
+    h0 = harvest_s()
     t_tc = time.perf_counter()
     tstate, trows = run_traffic_rounds(tparams, ttables_c, tt, tstate, 3)
     jax.block_until_ready(trows["converged"])
-    traffic_compile_dt = time.perf_counter() - t_tc
+    traffic_compile_dt = time.perf_counter() - t_tc - (harvest_s() - h0)
+    h0 = harvest_s()
     t_tr = time.perf_counter()
     tstate, trows = run_traffic_rounds(tparams, ttables_c, tt, tstate,
                                        titers, start_it=3)
     jax.block_until_ready(trows["converged"])
-    traffic_dt = time.perf_counter() - t_tr
+    traffic_dt = time.perf_counter() - t_tr - (harvest_s() - h0)
     traffic_converged = int(np.asarray(trows["converged"]).sum())
     traffic_retired = int(np.asarray(trows["retired"]).sum())
     _rm = np.asarray(trows["ret_mask"])
     traffic_ret_cov = (float(np.asarray(trows["ret_holders"])[_rm].sum()
                              / (tn * max(traffic_retired, 1)))
                        if traffic_retired else 0.0)
+    # captured BEFORE the adaptive rung compiles, so these XLA bytes are
+    # the push-traffic executables alone
+    traffic_capacity = rung_capacity(tparams, "engine/run_traffic_rounds")
 
     # ---- adaptive traffic rung: the same starved workload healed by the
     # direction-optimizing switch (adaptive.py, ISSUE 11).  Identical
@@ -238,15 +297,17 @@ def worker(args) -> int:
     # ~98.7% coverage; the per-value pull-rescue phase finishes them.
     aparams = tparams._replace(gossip_mode="adaptive")
     astate = init_traffic_state(tstakes, aparams, seed=0)
+    h0 = harvest_s()
     t_ac = time.perf_counter()
     astate, arows = run_traffic_rounds(aparams, ttables_c, tt, astate, 3)
     jax.block_until_ready(arows["converged"])
-    adaptive_compile_dt = time.perf_counter() - t_ac
+    adaptive_compile_dt = time.perf_counter() - t_ac - (harvest_s() - h0)
+    h0 = harvest_s()
     t_ar = time.perf_counter()
     astate, arows = run_traffic_rounds(aparams, ttables_c, tt, astate,
                                        titers, start_it=3)
     jax.block_until_ready(arows["converged"])
-    adaptive_dt = time.perf_counter() - t_ar
+    adaptive_dt = time.perf_counter() - t_ar - (harvest_s() - h0)
     a_conv = int(np.asarray(arows["converged"]).sum())
     a_ret = int(np.asarray(arows["retired"]).sum())
     _am = np.asarray(arows["ret_mask"])
@@ -254,6 +315,9 @@ def worker(args) -> int:
     a_vals_rescued = int(np.count_nonzero(
         np.asarray(arows["ret_rescued"])[_am]
         * np.asarray(arows["ret_full"])[_am]))
+    # site peaks now include the adaptive executables (max over both
+    # traffic statics — the adaptive graph is the larger of the two)
+    adaptive_capacity = rung_capacity(aparams, "engine/run_traffic_rounds")
 
     result = bench_summary(
         reg, platform=platform, num_nodes=n, origin_batch=o,
@@ -266,6 +330,7 @@ def worker(args) -> int:
         "iters_per_step": sweep_iters,
         "warm_steps_elapsed_s": round(sweep_dt, 3),
         "compiles_during_warm_steps": sweep_compiles,
+        **sweep_capacity,
     }
     result["lane_sweep_steps_per_sec"] = round(
         lanes / lane_dt, 2) if lane_dt > 0 else 0.0
@@ -279,6 +344,7 @@ def worker(args) -> int:
                                   (sweep_steps / sweep_dt), 3)
                             if lane_dt > 0 and sweep_dt > 0
                             and sweep_steps else 0.0),
+        **lane_capacity,
     }
     result["traffic_steps_per_sec"] = round(
         titers / traffic_dt, 2) if traffic_dt > 0 else 0.0
@@ -301,6 +367,7 @@ def worker(args) -> int:
         "injected": int(np.asarray(trows["injected"]).sum()),
         "queue_dropped": int(np.asarray(trows["queue_dropped"]).sum()),
         "deferred": int(np.asarray(trows["deferred"]).sum()),
+        **traffic_capacity,
     }
     result["adaptive_traffic_steps_per_sec"] = round(
         titers / adaptive_dt, 2) if adaptive_dt > 0 else 0.0
@@ -326,6 +393,24 @@ def worker(args) -> int:
             "values_rescued": a_vals_rescued,
             "values_retired": a_ret - traffic_retired,
         },
+        **adaptive_capacity,
+    }
+    # run-level capacity line (ROADMAP item 1's measured memory baseline;
+    # tools/bench_trend.py tracks these across rounds)
+    hs = capacity.harvest_summary()
+    result["capacity"] = {
+        **main_capacity,
+        # the run-level peak is read HERE, after every rung: VmHWM is
+        # monotone and the traffic/adaptive rungs allocate ~3x the main
+        # rung (main_capacity's own peak key is the main-rung snapshot)
+        "peak_rss_bytes": memwatch.peak_rss_bytes(),
+        "xla_peak_temp_bytes": hs["peak_temp_bytes"],
+        "xla_flops": hs["flops"],
+        "cost_harvests": hs["harvests"],
+        "cost_harvest_failures": hs["failures"],
+        # total AOT harvest-compile seconds (deducted from every timed
+        # figure above — see the worker preamble)
+        "harvest_compile_s": round(harvest_s(), 3),
     }
     pc = persistent_cache_counters()
     result["compilation_cache"] = {

@@ -141,6 +141,25 @@ def _sync_cache_counters() -> None:
         reg.set_info("compilation_cache_dir", persistent_cache_dir() or "")
 
 
+def _note_capacity_ledger(config, params, *, origin_batch: int = 1,
+                          lanes: int = 0) -> None:
+    """Stamp the run's closed-form capacity ledger (obs/capacity.py) into
+    registry info so the run report's ``capacity.ledger`` section and the
+    ``sim_capacity`` Influx point carry exact byte attribution for THIS
+    configuration.  Pure host arithmetic (~100 us); called once per run
+    path where the EngineParams and the batch geometry are known.  A
+    telemetry failure must never kill a run."""
+    try:
+        from .obs import capacity
+        led = capacity.capacity_ledger(
+            params, origin_batch=origin_batch, lanes=lanes,
+            trace=bool(config.trace_dir),
+            origins_scale_with_n=config.all_origins)
+        get_registry().set_info("capacity_ledger", led)
+    except Exception as e:  # pragma: no cover - telemetry-only path
+        log.warning("WARNING: capacity ledger unavailable (%s)", e)
+
+
 def _impair_params(config) -> dict:
     """EngineParams kwargs for the fault-injection knobs (engine/params.py)."""
     return dict(packet_loss_rate=config.packet_loss_rate,
@@ -438,6 +457,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "config, environment, span timings, throughput, "
                         "fault + influx counters (schema shared with "
                         "bench.py; see obs/report.py)")
+    p.add_argument("--memwatch-interval-s", type=float, default=0.0,
+                   metavar="S",
+                   help="capacity observatory (obs/memwatch.py): sample "
+                        "host RSS + device memory_stats every S seconds "
+                        "on a low-overhead thread; peak + series land in "
+                        "the run report's capacity section and the "
+                        "sim_capacity Influx series. 0 = off (the report "
+                        "still carries the kernel peak-RSS mark). Zero "
+                        "bit-impact on simulation output")
+    p.add_argument("--capacity-harvest", action="store_true",
+                   help="capacity observatory (obs/capacity.py): capture "
+                        "XLA cost_analysis/memory_analysis (FLOPs, "
+                        "argument/output/temp/generated-code bytes) per "
+                        "compiled engine executable, keyed by compile-"
+                        "cache entry so warm calls reuse the harvest. "
+                        "Costs one extra XLA compile per distinct "
+                        "executable (pair with --compilation-cache-dir "
+                        "to make it a disk hit); zero bit-impact")
     p.add_argument("--trace-dir", default="", metavar="DIR",
                    help="flight recorder (obs/trace.py): capture per-round "
                         "protocol events (delivery edges + outcomes, first-"
@@ -535,6 +572,8 @@ def config_from_args(args) -> Config:
         raise SystemExit("mesh-node-shards must be >= 1")
     if args.sweep_lanes < 0:
         raise SystemExit("sweep-lanes must be >= 0")
+    if args.memwatch_interval_s < 0:
+        raise SystemExit("memwatch-interval-s must be >= 0")
     return Config(
         gossip_push_fanout=args.push_fanout,
         gossip_active_set_size=args.active_set_size,
@@ -590,6 +629,8 @@ def config_from_args(args) -> Config:
         mesh_node_shards=args.mesh_node_shards,
         jax_profile_dir=args.jax_profile_dir,
         run_report_path=args.run_report_path,
+        memwatch_interval_s=args.memwatch_interval_s,
+        capacity_harvest=args.capacity_harvest,
         trace_dir=args.trace_dir,
         trace_origins=args.trace_origins,
         trace_prune_cap=args.trace_prune_cap,
@@ -846,6 +887,7 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
         tables = make_cluster_tables(index.stakes.astype(np.int64))
     reg.set_info("platform", jax.devices()[0].platform)
     reg.set_info("origin_batch", 1)
+    _note_capacity_ledger(config, params)
     origin_idx = index.index_of(origin_pubkey)
     origins = jnp.asarray([origin_idx], dtype=jnp.int32)
 
@@ -1157,6 +1199,7 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
         tables = make_cluster_tables(index.stakes.astype(np.int64))
     reg.set_info("platform", jax.devices()[0].platform)
     reg.set_info("origin_batch", R)
+    _note_capacity_ledger(config, params, origin_batch=R)
 
     stats_list = []
     for i, c in enumerate(configs):
@@ -1411,6 +1454,7 @@ def run_lane_sweep(config: Config, json_rpc_url: str, origin_ranks,
     reg.set_info("origin_batch", 1)
     reg.set_info("sweep_lanes", L)
     reg.set_info("lane_batches", n_batches)
+    _note_capacity_ledger(config, params_list[0], lanes=L)
     origin_idx = index.index_of(origin_pubkey)
     origins = jnp.asarray([origin_idx], dtype=jnp.int32)
 
@@ -1713,6 +1757,7 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         o_shards = mesh_dev // node_shards
         batch = max(o_shards, batch // o_shards * o_shards)
     reg.set_info("origin_batch", batch)
+    _note_capacity_ledger(config, params, origin_batch=batch)
     reg.set_info("mesh_shape",
                  [mesh_dev // node_shards, node_shards]
                  if mesh is not None else [1])
@@ -2338,12 +2383,56 @@ def _dispatch_supervised(config: Config, label: str, run_fn, state=None):
 # run-report + influx-drain helpers (obs/)
 # --------------------------------------------------------------------------
 
-def _drain_influx(dp_queue, influx_thread):
+def _push_sim_capacity_point(dp_queue, start_ts: str) -> None:
+    """End-of-run ``sim_capacity`` point (obs/capacity.py ledger totals +
+    obs/memwatch.py peaks + cost-harvest peaks).  Wall-clock-valued, so
+    drain_deterministic_lines drops it — the parity surface is
+    unaffected whether or not capacity telemetry ran."""
+    if dp_queue is None:
+        return
+    try:
+        from .obs import capacity, memwatch
+        led = get_registry().info("capacity_ledger") or {}
+        cost = capacity.harvest_summary()
+        mem = memwatch.snapshot()
+        dp = InfluxDataPoint(start_ts)
+        dp.create_sim_capacity_point({
+            "ledger_total_bytes": int(led.get("total_bytes", 0)),
+            "ledger_state_bytes": int(led.get("state_bytes", 0)),
+            "bytes_per_node": float(led.get("bytes_per_node", 0.0)),
+            "dense_bytes": int(led.get("dense_bytes", 0)),
+            "peak_rss_bytes": int(mem.get("peak_rss_bytes", 0)),
+            "peak_device_bytes": int(mem.get("peak_device_bytes", 0)),
+            "memwatch_samples": int(mem.get("samples", 0)),
+            "xla_peak_temp_bytes": int(cost.get("peak_temp_bytes", 0)),
+            "xla_peak_argument_bytes": int(
+                cost.get("peak_argument_bytes", 0)),
+            "xla_flops": float(cost.get("flops", 0.0)),
+            "cost_harvests": int(cost.get("harvests", 0)),
+        })
+        dp_queue.push_back(dp)
+    except Exception as e:  # pragma: no cover - telemetry-only path
+        log.warning("WARNING: sim_capacity point not emitted (%s)", e)
+
+
+def _drain_influx(dp_queue, influx_thread, start_ts: str = "0",
+                  emit_capacity: bool = False):
     """Push the end sentinel, drain the reporter thread, and surface the
     sender's delivery accounting (points sent / dropped / retries) at
-    end-of-run instead of only inside the drain log."""
+    end-of-run instead of only inside the drain log.  ``emit_capacity``
+    (main()'s end-of-run drains) rides the run's ``sim_capacity`` point
+    out just before the sentinel."""
+    try:
+        # every main() exit passes through here: close the footprint
+        # series before the capacity point / run report read it
+        from .obs import memwatch as _mw
+        _mw.stop()
+    except Exception:  # pragma: no cover
+        pass
     if dp_queue is None:
         return None
+    if emit_capacity:
+        _push_sim_capacity_point(dp_queue, start_ts)
     dp = InfluxDataPoint()
     dp.set_last_datapoint()
     dp_queue.push_back(dp)
@@ -2641,6 +2730,7 @@ def _run_traffic_tpu_point(config, params, stakes_np, index, stats,
         tables = make_cluster_tables(stakes_np)
         ttables = device_traffic_tables(stakes_np)
     reg.set_info("platform", jax.devices()[0].platform)
+    _note_capacity_ledger(config, params)
 
     tracer = None
     if config.trace_dir:
@@ -2847,6 +2937,7 @@ def _run_traffic_lane_sweep(config, point_cfgs, accounts, collection,
     lanes = max(1, min(config.sweep_lanes, K))
     reg.set_info("sweep_lanes", lanes)
     reg.set_info("lane_batches", (K + lanes - 1) // lanes)
+    _note_capacity_ledger(config, params_list[0], lanes=lanes)
     warm = min(config.warm_up_rounds, config.gossip_iterations)
     measured = config.gossip_iterations - warm
     base_state = init_traffic_state(stakes_np, params_list[0], config.seed)
@@ -3214,6 +3305,17 @@ def main(argv=None) -> int:
     # any shutdown request a previous in-process run left behind
     get_registry().reset()
     resilience.reset_shutdown()
+    # capacity observatory (obs/capacity.py + obs/memwatch.py): same
+    # one-process-one-run reset, opt-in XLA cost harvest, and the live
+    # footprint sampler when an interval was requested.  All three are
+    # bit-invisible to the simulation (tools/capacity_smoke.py).
+    from .obs import capacity as _capacity
+    from .obs import memwatch as _memwatch
+    _capacity.reset_harvests()
+    _capacity.set_harvest_enabled(config.capacity_harvest)
+    _memwatch.reset()
+    if config.memwatch_interval_s > 0:
+        _memwatch.start(config.memwatch_interval_s)
     origin_ranks = args.origin_rank
     if any(r < 1 for r in origin_ranks):
         log.error("ERROR: --origin-rank values must be >= 1 (1 = highest "
@@ -3393,7 +3495,8 @@ def main(argv=None) -> int:
         # stamp a (partial) run report, and exit with the distinct
         # resumable code so a wrapper can loop on --resume
         log.warning("run interrupted resumably: %s", e)
-        influx_stats = _drain_influx(dp_queue, influx_thread)
+        influx_stats = _drain_influx(dp_queue, influx_thread,
+                                     start_ts, emit_capacity=True)
         stats = faults = None
         if collection is not None:
             stats, faults = _collection_summaries(collection)
@@ -3406,13 +3509,15 @@ def main(argv=None) -> int:
         return RESUMABLE_EXIT_CODE
 
     if config.traffic_on:
-        influx_stats = _drain_influx(dp_queue, influx_thread)
+        influx_stats = _drain_influx(dp_queue, influx_thread,
+                                     start_ts, emit_capacity=True)
         _write_run_report(config, stats=traffic_summary,
                           influx=influx_stats)
         return 0
 
     if config.all_origins:
-        influx_stats = _drain_influx(dp_queue, influx_thread)
+        influx_stats = _drain_influx(dp_queue, influx_thread,
+                                     start_ts, emit_capacity=True)
         stats = {
             "coverage_mean": summary["coverage_mean"],
             "rmr_mean": summary["rmr_mean"],
@@ -3446,7 +3551,8 @@ def main(argv=None) -> int:
                           influx=influx_stats)
         return 0
 
-    influx_stats = _drain_influx(dp_queue, influx_thread)
+    influx_stats = _drain_influx(dp_queue, influx_thread, start_ts,
+                                 emit_capacity=True)
     stats, faults = _collection_summaries(collection)
     _write_run_report(config, stats=stats, faults=faults,
                       influx=influx_stats)

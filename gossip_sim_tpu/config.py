@@ -187,6 +187,19 @@ class Config:
                                     # round/* named_scope stages (obs/)
     run_report_path: str = ""       # write the machine-readable run report
                                     # (obs/report.py schema) to this path
+    memwatch_interval_s: float = 0.0  # live footprint sampler interval
+                                    # (obs/memwatch.py): poll host RSS +
+                                    # device memory_stats every this many
+                                    # seconds; 0 = off (the run report
+                                    # still carries the kernel peak-RSS
+                                    # high-water mark)
+    capacity_harvest: bool = False  # XLA cost harvest (obs/capacity.py):
+                                    # capture cost_analysis/
+                                    # memory_analysis per compiled engine
+                                    # executable.  Costs ONE extra XLA
+                                    # compile per distinct executable
+                                    # (cheap with --compilation-cache-dir)
+                                    # and zero bits of simulation impact
     trace_dir: str = ""             # flight recorder (obs/trace.py): write
                                     # per-round protocol event traces
                                     # (schema gossip-sim-tpu/trace/v1) here

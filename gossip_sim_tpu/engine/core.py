@@ -74,6 +74,7 @@ from ..adaptive import switch_update_arr
 from ..faults import (SALT_CHURN, SALT_EDGE, edge_u32_arr, node_u32_arr,
                       rate_threshold_arr, round_basis_arr, stake_bipartition)
 from ..identity import stake_buckets_array
+from ..obs import capacity
 from ..obs.spans import get_registry
 from ..obs.trace import (TRACE_CANDIDATE, TRACE_DROPPED, TRACE_FAILED_TARGET,
                          TRACE_SUPPRESSED)
@@ -1288,9 +1289,14 @@ def run_rounds(params, tables: ClusterTables, origins: jax.Array,
     which stacks the K knob vectors of a sweep into a lane axis and runs
     them as ONE batched device program instead of K calls through here."""
     static, kn = _split_params(params, knobs)
+    args = (static, tables, origins, state, kn, int(num_iters),
+            bool(detail), bool(edge_detail), bool(trace),
+            jnp.asarray(start_it, jnp.int32))
+    # capacity observatory (obs/capacity.py): BEFORE the dispatch — the
+    # scan donates its state buffers, and lower() only reads avals.  A
+    # single bool check when the harvest is off.
+    capacity.harvest_dispatch("engine/run_rounds", _run, args)
     before = compiled_cache_size()
-    out = _run(static, tables, origins, state, kn, int(num_iters),
-               bool(detail), bool(edge_detail), bool(trace),
-               jnp.asarray(start_it, jnp.int32))
+    out = _run(*args)
     _note_compile_accounting(before, compiled_cache_size())
     return out

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..obs import capacity
 from .core import (SimState, _check_knob_gates, _note_compile_accounting,
                    round_step)
 from .params import EngineKnobs, EngineStatic
@@ -146,8 +147,10 @@ def run_rounds_lanes(static: EngineStatic, tables, origins, states: SimState,
     per-sim stats paths unchanged.  Records ``engine/compiles`` /
     ``engine/cache_hits`` on the shared span registry exactly like
     :func:`run_rounds`."""
+    args = (static, tables, origins, states, knobs, int(num_iters),
+            bool(detail), jnp.asarray(start_it, jnp.int32))
+    capacity.harvest_dispatch("engine/run_rounds_lanes", _run_lanes, args)
     before = lane_cache_size()
-    out = _run_lanes(static, tables, origins, states, knobs, int(num_iters),
-                     bool(detail), jnp.asarray(start_it, jnp.int32))
+    out = _run_lanes(*args)
     _note_compile_accounting(before, lane_cache_size())
     return out

@@ -60,6 +60,7 @@ from ..traffic import (SALT_TRAFFIC_LOSS, SALT_TRAFFIC_OCLASS,
                        TRAFFIC_SUPPRESSED, TrafficTables,
                        build_shared_active_set, class_draw_arr,
                        traffic_tables, u01_arr, value_basis_arr)
+from ..obs import capacity
 from .core import (BIG, INF, ClusterTables, _lookup, _note_compile_accounting,
                    _pack_base, _rank_in_run, _split_params)
 from .params import EngineKnobs
@@ -1011,10 +1012,12 @@ def run_traffic_rounds(params, tables: ClusterTables,
     :class:`EngineStatic` key is hashed, every traffic knob is traced, and
     each call records ``engine/compiles`` or ``engine/cache_hits``."""
     static, kn = _split_params(params, knobs)
+    args = (static, tables, ttables, state, kn, int(num_iters),
+            bool(detail), bool(trace), jnp.asarray(start_it, jnp.int32))
+    capacity.harvest_dispatch("engine/run_traffic_rounds", _run_traffic,
+                              args)
     before = traffic_compiled_cache_size()
-    out = _run_traffic(static, tables, ttables, state, kn, int(num_iters),
-                       bool(detail), bool(trace),
-                       jnp.asarray(start_it, jnp.int32))
+    out = _run_traffic(*args)
     _note_compile_accounting(before, traffic_compiled_cache_size())
     return out
 
@@ -1041,9 +1044,11 @@ def run_traffic_lanes(static, tables: ClusterTables, ttables: TrafficTables,
     batched device program (engine/lanes.py contract: each lane is
     bit-identical to a serial :func:`run_traffic_rounds` call).  Trace
     rows are not offered in lane mode (same restriction as lanes.py)."""
+    args = (static, tables, ttables, lane_state, lane_knobs,
+            int(num_iters), bool(detail), jnp.asarray(start_it, jnp.int32))
+    capacity.harvest_dispatch("engine/run_traffic_lanes",
+                              _run_traffic_lanes, args)
     before = traffic_compiled_cache_size()
-    out = _run_traffic_lanes(static, tables, ttables, lane_state, lane_knobs,
-                             int(num_iters), bool(detail),
-                             jnp.asarray(start_it, jnp.int32))
+    out = _run_traffic_lanes(*args)
     _note_compile_accounting(before, traffic_compiled_cache_size())
     return out

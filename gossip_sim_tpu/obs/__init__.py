@@ -5,6 +5,10 @@ JAX touches in subprocesses); the differential-timing helpers live in
 :mod:`gossip_sim_tpu.obs.difftime` and import JAX only when called.
 """
 
+from .capacity import (CAPACITY_SCHEMA, capacity_ledger, fit_budget,
+                       harvest_summary, parse_size,
+                       predict_sim_state_bytes,
+                       predict_traffic_state_bytes, set_harvest_enabled)
 from .heartbeat import Heartbeat
 from .report import (PER_CHIP_TARGET, RUN_REPORT_SCHEMA, bench_summary,
                      build_run_report, environment_info, validate_run_report,
@@ -20,4 +24,7 @@ __all__ = [
     "write_run_report",
     "TRACE_SCHEMA", "OracleTraceCollector", "Trace", "TraceWriter",
     "load_trace", "validate_trace_dir", "validate_trace_manifest",
+    "CAPACITY_SCHEMA", "capacity_ledger", "fit_budget", "harvest_summary",
+    "parse_size", "predict_sim_state_bytes", "predict_traffic_state_bytes",
+    "set_harvest_enabled",
 ]

@@ -1,0 +1,655 @@
+"""Capacity observatory: exact memory attribution + XLA cost harvest.
+
+ROADMAP item 1 (sparse engine for 100k-1M nodes) needs to *measure* the
+dense per-node tables it is refactoring away — until this module, the
+repo's memory story was "run it and watch for the OOM".  Three layers,
+all host-side and JAX-free at import (the bench parent-process contract):
+
+1. **Static capacity ledger** — walks the exact array inventory of the
+   carried pytrees (:class:`SimState`, :class:`TrafficState`,
+   :class:`EngineKnobs`, the flight-recorder trace rows, the static
+   cluster tables) and emits per-array byte attribution as closed-form
+   functions of ``(N, S, M, lanes, trace caps)``.  The totals are
+   *bit-exact* against live device buffers: for every supported config,
+   ``predict_sim_state_bytes(params, O) == sum(x.nbytes for x in state)``
+   (tests/test_capacity.py, tools/capacity_smoke.py).  Every term whose
+   bytes grow quadratically in N under the run's interpretation (the
+   origin axis tracks N in ``--all-origins`` mode) is flagged — those are
+   exactly the dense tables blocking web scale (FS_GPlib, PAPERS.md).
+
+2. **XLA cost harvest** — captures ``compiled.cost_analysis()`` and
+   ``compiled.memory_analysis()`` (FLOPs, transcendentals, argument /
+   output / temp / generated-code bytes) for the engine executables.  The
+   harvest is keyed by compile-cache entry (site label + static key +
+   abstract arg specs + dispatch epoch), so warm calls reuse the harvest
+   for free.  Harvesting a NEW entry pays one extra XLA compile (JAX's
+   AOT ``lower().compile()`` does not share the jit execution cache), so
+   it is **opt-in** (``--capacity-harvest`` / :func:`set_harvest_enabled`)
+   and pairs well with the persistent compilation cache
+   (``--compilation-cache-dir``), which turns the second compile into a
+   disk hit.  The resilience supervisor bumps the dispatch epoch on
+   retries/CPU-fallback so re-dispatched units re-harvest against the
+   executable they actually ran (resilience.py).
+
+3. **Planning queries** — :func:`fit_budget` (largest N that fits a byte
+   budget, exact ledger arithmetic, no device needed) and N-projection
+   via re-evaluating the ledger at hypothetical N — the closed forms make
+   extrapolation exact, which is what ``tools/capacity_report.py`` builds
+   its ROADMAP-item-1 evidence tables from.
+
+Nothing here touches simulation state: enabling the ledger, the harvest
+or the memwatch sampler has zero bit-impact on stats parity snapshots
+and Influx wire lines (tools/capacity_smoke.py enforces this).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import NamedTuple
+
+import numpy as np
+
+from .spans import get_registry
+
+CAPACITY_SCHEMA = "gossip-sim-tpu/capacity-ledger/v1"
+
+#: stake-bucket class count (sampler/pull tables width)
+_NB = 25
+
+#: default trace harvest block (cli.HARVEST_BLOCK; kept in sync by a test)
+TRACE_BLOCK_ROUNDS = 256
+
+_DTYPE_BYTES = {"bool": 1, "int32": 4, "uint32": 4, "int64": 8,
+                "uint64": 8, "float32": 4, "float64": 8}
+
+
+class LedgerEntry(NamedTuple):
+    """One array's closed-form byte attribution."""
+
+    name: str       # pytree field (dotted path for nested containers)
+    group: str      # subsystem: active-set | received-cache |
+                    # traffic-planes | stats | pull | adaptive | core |
+                    # tables | knobs | trace
+    shape: tuple    # concrete shape at this config
+    dtype: str
+    bytes: int      # exact: prod(shape) * itemsize
+    formula: str    # the closed form, e.g. "O*N*S*4"
+    n_degree: int   # polynomial degree in N under this config's
+                    # interpretation (the O axis counts when
+                    # origins_scale_with_n); >= 2 == a dense web-scale
+                    # blocker (the ROADMAP item 1 refactor targets)
+    exact: bool = True  # False = workspace *estimate*, excluded from the
+                        # bit-exact state totals and the parity tests
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "group": self.group,
+                "shape": list(self.shape), "dtype": self.dtype,
+                "bytes": int(self.bytes), "formula": self.formula,
+                "n_degree": int(self.n_degree), "exact": bool(self.exact)}
+
+
+def _entry(name, group, shape, dtype, formula, n_degree, exact=True):
+    size = int(np.prod([int(s) for s in shape], dtype=np.int64)) if shape \
+        else 1
+    return LedgerEntry(name=name, group=group, shape=tuple(int(s) for s
+                                                           in shape),
+                       dtype=dtype, bytes=size * _DTYPE_BYTES[dtype],
+                       formula=formula, n_degree=n_degree, exact=exact)
+
+
+# --------------------------------------------------------------------------
+# per-pytree inventories (must mirror the NamedTuple definitions exactly)
+# --------------------------------------------------------------------------
+
+def sim_state_entries(params, origin_batch: int = 1,
+                      origins_scale_with_n: bool = False) -> list:
+    """The exact array inventory of one :class:`SimState` with O origin
+    columns (engine/core.py init_state — field order preserved).  ``sum
+    of bytes`` equals ``sum(x.nbytes)`` of a live instance bit-exactly."""
+    N, S, C, H = (params.num_nodes, params.active_set_size, params.rc_slots,
+                  params.hist_bins)
+    O = int(origin_batch)
+    od = 1 if origins_scale_with_n else 0   # the O axis tracks N?
+    e = _entry
+    return [
+        e("key", "core", (O, 2), "uint32", "O*2*4", od),
+        e("active", "active-set", (O, N, S), "int32", "O*N*S*4", 1 + od),
+        e("pruned", "active-set", (O, N, S), "bool", "O*N*S*1", 1 + od),
+        e("tfail", "active-set", (O, N, S), "bool", "O*N*S*1", 1 + od),
+        e("rc_src", "received-cache", (O, N, C), "int32", "O*N*C*4", 1 + od),
+        e("rc_score", "received-cache", (O, N, C), "int32", "O*N*C*4",
+          1 + od),
+        e("rc_shi", "received-cache", (O, N, C), "int32", "O*N*C*4", 1 + od),
+        e("rc_slo", "received-cache", (O, N, C), "int32", "O*N*C*4", 1 + od),
+        e("rc_upserts", "received-cache", (O, N), "int32", "O*N*4", 1 + od),
+        e("failed", "core", (O, N), "bool", "O*N*1", 1 + od),
+        e("egress_acc", "stats", (O, N), "int32", "O*N*4", 1 + od),
+        e("ingress_acc", "stats", (O, N), "int32", "O*N*4", 1 + od),
+        e("prune_acc", "stats", (O, N), "int32", "O*N*4", 1 + od),
+        e("stranded_acc", "stats", (O, N), "int32", "O*N*4", 1 + od),
+        e("hops_hist_acc", "stats", (O, H), "int32", "O*H*4", od),
+        e("pull_hops_hist_acc", "pull", (O, H), "int32", "O*H*4", od),
+        e("pull_rescued_acc", "pull", (O, N), "int32", "O*N*4", 1 + od),
+        e("adaptive_pull_on", "adaptive", (O,), "bool", "O*1", od),
+    ]
+
+
+def traffic_state_entries(params) -> list:
+    """The exact array inventory of one :class:`TrafficState`
+    (engine/traffic.py init_traffic_state).  The value axis V is the
+    static ``traffic_slots`` (M) — the per-value planes scale as M*N, the
+    shared network as N alone."""
+    static = params.static_part()
+    V = static.traffic_slots
+    if V <= 0:
+        return []
+    N, S, C = params.num_nodes, params.active_set_size, params.rc_slots
+    e = _entry
+    return [
+        e("active", "active-set", (N, S), "int32", "N*S*4", 1),
+        e("failed", "core", (N,), "bool", "N*1", 1),
+        e("next_vid", "core", (), "int32", "4", 0),
+        e("v_live", "traffic-planes", (V,), "bool", "M*1", 0),
+        e("v_vid", "traffic-planes", (V,), "int32", "M*4", 0),
+        e("v_origin", "traffic-planes", (V,), "int32", "M*4", 0),
+        e("v_birth", "traffic-planes", (V,), "int32", "M*4", 0),
+        e("v_stall", "traffic-planes", (V,), "int32", "M*4", 0),
+        e("v_holder", "traffic-planes", (V, N), "bool", "M*N*1", 1),
+        e("v_hop", "traffic-planes", (V, N), "int32", "M*N*4", 1),
+        e("v_m", "traffic-planes", (V,), "int32", "M*4", 0),
+        e("pruned", "active-set", (V, N, S), "bool", "M*N*S*1", 1),
+        e("rc_src", "received-cache", (V, N, C), "int32", "M*N*C*4", 1),
+        e("rc_score", "received-cache", (V, N, C), "int32", "M*N*C*4", 1),
+        e("rc_shi", "received-cache", (V, N, C), "int32", "M*N*C*4", 1),
+        e("rc_slo", "received-cache", (V, N, C), "int32", "M*N*C*4", 1),
+        e("rc_upserts", "received-cache", (V, N), "int32", "M*N*4", 1),
+        e("inj_acc", "stats", (), "int32", "4", 0),
+        e("injdrop_acc", "stats", (), "int32", "4", 0),
+        e("ret_acc", "stats", (), "int32", "4", 0),
+        e("conv_acc", "stats", (), "int32", "4", 0),
+        e("defer_acc", "stats", (N,), "int32", "N*4", 1),
+        e("qdrop_acc", "stats", (N,), "int32", "N*4", 1),
+        e("sent_acc", "stats", (N,), "int32", "N*4", 1),
+        e("recv_acc", "stats", (N,), "int32", "N*4", 1),
+        e("prune_acc", "stats", (N,), "int32", "N*4", 1),
+        e("v_pull", "adaptive", (V,), "bool", "M*1", 0),
+        e("v_rescued", "adaptive", (V,), "int32", "M*4", 0),
+        e("v_qdrop", "adaptive", (V,), "int32", "M*4", 0),
+    ]
+
+
+def cluster_tables_entries(params,
+                           origins_scale_with_n: bool = False) -> list:
+    """Static per-cluster device tables (ClusterTables + SamplerTables)."""
+    N = params.num_nodes
+    e = _entry
+    return [
+        e("stakes", "tables", (N + 1,), "int64", "(N+1)*8", 1),
+        e("buckets", "tables", (N,), "int32", "N*4", 1),
+        e("sampler.perm", "tables", (N,), "int32", "N*4", 1),
+        e("sampler.class_start", "tables", (_NB,), "int32", "NB*4", 0),
+        e("sampler.class_count", "tables", (_NB,), "int32", "NB*4", 0),
+        e("sampler.class_cdf", "tables", (_NB, _NB), "float32", "NB*NB*4",
+          0),
+        e("sampler.cdf_own", "tables", (N, _NB), "float32", "N*NB*4", 1),
+        e("shi", "tables", (N + 1,), "int32", "(N+1)*4", 1),
+        e("slo", "tables", (N + 1,), "int32", "(N+1)*4", 1),
+        # np.concatenate([...i32, [0]]) promotes: the live array is i64
+        e("side", "tables", (N + 1,), "int64", "(N+1)*8", 1),
+    ]
+
+
+def traffic_tables_entries(params) -> list:
+    """TrafficTables (traffic.py): the shared top-entry class CDF."""
+    if params.static_part().traffic_slots <= 0:
+        return []
+    N = params.num_nodes
+    e = _entry
+    return [
+        e("traffic.perm", "tables", (N,), "int32", "N*4", 1),
+        e("traffic.class_start", "tables", (_NB,), "int32", "NB*4", 0),
+        e("traffic.class_count", "tables", (_NB,), "int32", "NB*4", 0),
+        e("traffic.cdf", "tables", (_NB,), "float32", "NB*4", 0),
+    ]
+
+
+def knobs_entries() -> list:
+    """:class:`EngineKnobs` — every traced scalar, exact per-leaf dtype
+    bytes (the pytree the lane runner stacks into [K] leaves).  Dtypes
+    are read off a canonical instance (params.py is JAX-free), so a new
+    knob can never drift out of the ledger."""
+    from ..engine.params import EngineParams
+    kn = EngineParams(num_nodes=2).knob_values()
+    out = []
+    for field, value in zip(kn._fields, kn):
+        dt = np.asarray(value).dtype
+        out.append(_entry(f"knobs.{field}", "knobs", (), str(dt),
+                          str(dt.itemsize), 0))
+    return out
+
+
+def trace_entries(params, origin_batch: int = 1,
+                  rounds: int = TRACE_BLOCK_ROUNDS,
+                  origins_scale_with_n: bool = False) -> list:
+    """Flight-recorder capture rows per harvested block (obs/trace.py):
+    the extra device outputs a ``trace=True`` round emits, times the
+    ``rounds`` of one harvest block (cli.HARVEST_BLOCK).  This is the
+    peak *device-side* trace footprint; the npz segments on disk compress
+    it away."""
+    N, S = params.num_nodes, params.active_set_size
+    F = min(params.push_fanout, S)
+    PC = params.prune_cap
+    O, R = int(origin_batch), int(rounds)
+    od = 1 if origins_scale_with_n else 0
+    e = _entry
+    out = [
+        e("trace_peers", "trace", (R, O, N, F), "int32", "R*O*N*F*4",
+          1 + od),
+        e("trace_code", "trace", (R, O, N, F), "int32", "R*O*N*F*4", 1 + od),
+        e("trace_first", "trace", (R, O, N), "int32", "R*O*N*4", 1 + od),
+        e("trace_prune_src", "trace", (R, O, PC), "int32", "R*O*PC*4",
+          1 + od),   # PC resolves to 16*N by default — N-linear
+        e("trace_prune_dst", "trace", (R, O, PC), "int32", "R*O*PC*4",
+          1 + od),
+        e("trace_rot", "trace", (R, O, N), "int32", "R*O*N*4", 1 + od),
+        e("trace_active", "trace", (R, O, N, S), "int32", "R*O*N*S*4",
+          1 + od),
+        e("trace_pruned", "trace", (R, O, N, S), "bool", "R*O*N*S*1",
+          1 + od),
+    ]
+    if params.has_pull:
+        PS = params.pull_slots_resolved
+        out += [
+            e("trace_pull_peers", "trace", (R, O, N, PS), "int32",
+              "R*O*N*PS*4", 1 + od),
+            e("trace_pull_code", "trace", (R, O, N, PS), "int32",
+              "R*O*N*PS*4", 1 + od),
+        ]
+    return out
+
+
+def workspace_entries(params, origin_batch: int = 1,
+                      origins_scale_with_n: bool = False) -> list:
+    """*Estimates* of the dominant per-round sort workspaces (the dense
+    candidate/routing matrices engine/core.py materializes inside one
+    round).  Not part of the bit-exact state totals (``exact=False``) —
+    XLA's ``temp_size_in_bytes`` from the cost harvest is the measured
+    ground truth — but they name the O(N*F)/O(N*K) intermediates that,
+    multiplied by an N-wide origin axis, are the N^2 compute-side
+    barrier the ROADMAP item 1 sparse refactor removes."""
+    N, S = params.num_nodes, params.active_set_size
+    F = min(params.push_fanout, S)
+    K = params.k_inbound
+    O = int(origin_batch)
+    od = 1 if origins_scale_with_n else 0
+    e = _entry
+    return [
+        e("round.push_edges", "workspace", (O, N, F), "int32",
+          "O*N*F*4 (tgt/deliver candidates)", 1 + od, exact=False),
+        e("round.bfs_sort_keys", "workspace", (O, N * F + N), "int32",
+          "O*(N*F+N)*4 (frontier edge sort)", 1 + od, exact=False),
+        e("round.inbound_rank", "workspace", (O, 2 * (N * F + N)), "int32",
+          "O*2*(N*F+N)*4 (consume 4-key sort)", 1 + od, exact=False),
+        e("round.inbound_rows", "workspace", (O, N, K), "int32",
+          "O*N*K*4 (ranked inbound)", 1 + od, exact=False),
+        e("round.rc_merge_rows", "workspace", (O, N, params.rc_slots + K),
+          "int32", "O*N*(C+K)*4 (cache merge sort)", 1 + od, exact=False),
+        e("round.prune_apply_keys", "workspace", (O, N * S), "int32",
+          "O*N*S*4 (prune sort-join)", 1 + od, exact=False),
+    ]
+
+
+# --------------------------------------------------------------------------
+# the assembled ledger
+# --------------------------------------------------------------------------
+
+def _scale_lanes(entries: list, lanes: int) -> list:
+    """Prefix every entry with the lane axis K (engine/lanes.py
+    broadcast_state tiles the whole state pytree per lane)."""
+    K = int(lanes)
+    return [ent._replace(shape=(K,) + ent.shape, bytes=ent.bytes * K,
+                         formula=f"K*{ent.formula}")
+            for ent in entries]
+
+
+def capacity_ledger(params, *, origin_batch: int = 1, lanes: int = 0,
+                    trace: bool = False,
+                    trace_rounds: int = TRACE_BLOCK_ROUNDS,
+                    origins_scale_with_n: bool = False,
+                    include_workspace: bool = True) -> dict:
+    """The full closed-form memory ledger for one engine configuration.
+
+    ``origin_batch`` is the live O axis (1 for single runs, R for the
+    origin-rank batch, the batch width for ``--all-origins``); ``lanes``
+    > 0 multiplies the carried state by the lane axis K; ``trace`` adds
+    the flight-recorder block rows; ``origins_scale_with_n`` marks the O
+    axis as N-tracking for the dense-term flags (the all-origins /
+    web-scale interpretation: simulating every origin makes every
+    ``[O, N, ...]`` array O(N^2)).
+
+    Returns a JSON-safe dict; the ``state_bytes`` total is bit-exact vs
+    live donated buffers, ``total_bytes`` adds tables/knobs/trace, and
+    workspace estimates ride along unsummed (``exact: false``)."""
+    osn = bool(origins_scale_with_n)
+    traffic_on = params.static_part().traffic_slots > 0
+    if traffic_on:
+        state = traffic_state_entries(params)
+    else:
+        state = sim_state_entries(params, origin_batch,
+                                  origins_scale_with_n=osn)
+    if lanes and lanes > 0:
+        state = _scale_lanes(state, lanes)
+    tables = (cluster_tables_entries(params, origins_scale_with_n=osn)
+              + traffic_tables_entries(params))
+    knobs = knobs_entries()
+    if lanes and lanes > 0:
+        knobs = _scale_lanes(knobs, lanes)
+    # traffic-mode traces carry a value axis with their own caps
+    # (engine/traffic.py); the ledger models the single-origin recorder
+    trace_rows = (trace_entries(params, origin_batch, trace_rounds,
+                                origins_scale_with_n=osn)
+                  if trace and not traffic_on else [])
+    entries = state + tables + knobs + trace_rows
+    if include_workspace and not traffic_on:
+        entries = entries + workspace_entries(
+            params, origin_batch, origins_scale_with_n=osn)
+
+    groups: dict = {}
+    for ent in entries:
+        if ent.exact:
+            groups[ent.group] = groups.get(ent.group, 0) + ent.bytes
+    state_bytes = sum(ent.bytes for ent in state)
+    total = sum(ent.bytes for ent in entries if ent.exact)
+    # exact entries only: the workspace rows are estimates excluded from
+    # every total, so they must not be named as ledger dense terms either
+    # (they keep their n_degree flag in `entries` for the report tool)
+    dense = [ent for ent in entries if ent.n_degree >= 2 and ent.exact]
+    N = params.num_nodes
+    return {
+        "schema": CAPACITY_SCHEMA,
+        "num_nodes": int(N),
+        "origin_batch": int(origin_batch),
+        "lanes": int(lanes),
+        "traffic_slots": int(params.static_part().traffic_slots),
+        "gossip_mode": params.gossip_mode,
+        "trace": bool(trace),
+        "origins_scale_with_n": osn,
+        "entries": [ent.to_dict() for ent in entries],
+        "groups": {k: int(v) for k, v in sorted(groups.items())},
+        "state_bytes": int(state_bytes),
+        "total_bytes": int(total),
+        "bytes_per_node": round(total / max(N, 1), 2),
+        "state_bytes_per_node": round(state_bytes / max(N, 1), 2),
+        "dense_terms": [ent.name for ent in dense],
+        "dense_bytes": int(sum(ent.bytes for ent in dense)),
+    }
+
+
+def predict_sim_state_bytes(params, origin_batch: int = 1,
+                            lanes: int = 0) -> int:
+    """Exact total bytes of a live :class:`SimState` at this config —
+    the parity contract with ``sum(x.nbytes for x in state)``."""
+    entries = sim_state_entries(params, origin_batch)
+    if lanes and lanes > 0:
+        entries = _scale_lanes(entries, lanes)
+    return sum(ent.bytes for ent in entries)
+
+
+def predict_traffic_state_bytes(params, lanes: int = 0) -> int:
+    """Exact total bytes of a live :class:`TrafficState`."""
+    entries = traffic_state_entries(params)
+    if lanes and lanes > 0:
+        entries = _scale_lanes(entries, lanes)
+    return sum(ent.bytes for ent in entries)
+
+
+def measure_pytree(tree) -> tuple:
+    """(total_nbytes, [(leaf_path, shape, dtype, nbytes), ...]) of a live
+    pytree — the other arm of the exactness checks."""
+    import jax
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    rows = []
+    total = 0
+    for i, leaf in enumerate(leaves):
+        nb = int(getattr(leaf, "nbytes", 0))
+        rows.append((f"leaf{i}", tuple(getattr(leaf, "shape", ())),
+                     str(getattr(leaf, "dtype", "?")), nb))
+        total += nb
+    return total, rows
+
+
+# --------------------------------------------------------------------------
+# planning queries
+# --------------------------------------------------------------------------
+
+_SIZE_SUFFIXES = {"k": 10 ** 3, "m": 10 ** 6, "g": 10 ** 9, "t": 10 ** 12,
+                  "kb": 2 ** 10, "mb": 2 ** 20, "gb": 2 ** 30,
+                  "tb": 2 ** 40, "kib": 2 ** 10, "mib": 2 ** 20,
+                  "gib": 2 ** 30, "tib": 2 ** 40, "b": 1}
+
+
+def parse_size(text) -> int:
+    """'16GB' / '512MiB' / '2e9' -> bytes (binary units for the *B forms,
+    matching accelerator HBM marketing... which is what budgets quote)."""
+    if isinstance(text, (int, float)):
+        return int(text)
+    s = str(text).strip().lower().replace(" ", "")
+    for suf in sorted(_SIZE_SUFFIXES, key=len, reverse=True):
+        if s.endswith(suf):
+            return int(float(s[: -len(suf)]) * _SIZE_SUFFIXES[suf])
+    return int(float(s))
+
+
+def ledger_total_at(params, n: int, *, origin_batch=None, lanes: int = 0,
+                    trace: bool = False,
+                    origins_scale_with_n: bool = False) -> int:
+    """Exact ledger total re-evaluated at a hypothetical node count
+    ``n`` (the closed forms make this pure arithmetic — no device, no
+    MAX_NODES cap).  ``origin_batch=None`` keeps the configured batch;
+    with ``origins_scale_with_n`` the O axis is set to ``n`` itself (the
+    all-origins interpretation)."""
+    p = params._replace(num_nodes=int(n))
+    ob = int(n) if origins_scale_with_n else int(origin_batch or 1)
+    led = capacity_ledger(p, origin_batch=ob, lanes=lanes, trace=trace,
+                          origins_scale_with_n=origins_scale_with_n,
+                          include_workspace=False)
+    return led["total_bytes"]
+
+
+def fit_budget(params, budget_bytes: int, *, origin_batch: int = 1,
+               lanes: int = 0, trace: bool = False,
+               origins_scale_with_n: bool = False,
+               n_max: int = 1 << 30) -> int:
+    """Largest N whose exact ledger total fits ``budget_bytes`` (binary
+    search over the closed forms; 0 when even N=2 does not fit)."""
+    kw = dict(origin_batch=origin_batch, lanes=lanes, trace=trace,
+              origins_scale_with_n=origins_scale_with_n)
+    if ledger_total_at(params, 2, **kw) > budget_bytes:
+        return 0
+    lo, hi = 2, 4
+    while hi < n_max and ledger_total_at(params, hi, **kw) <= budget_bytes:
+        lo, hi = hi, hi * 2
+    hi = min(hi, n_max)
+    while lo + 1 < hi:
+        mid = (lo + hi) // 2
+        if ledger_total_at(params, mid, **kw) <= budget_bytes:
+            lo = mid
+        else:
+            hi = mid
+    return lo
+
+
+# --------------------------------------------------------------------------
+# XLA cost harvest (keyed by compile-cache entry)
+# --------------------------------------------------------------------------
+
+_harvest_lock = threading.Lock()
+_harvest_enabled = False
+_dispatch_epoch = 0
+_harvests: dict = {}          # key -> record dict
+_harvest_failures = 0
+
+
+def set_harvest_enabled(on: bool) -> None:
+    """Master switch (``--capacity-harvest``).  Off (the default) the
+    dispatch hook is a single boolean check — zero-cost paths stay
+    zero-cost.  On, each NEW compile-cache entry pays one extra XLA
+    compile to obtain the analyses (see module docstring)."""
+    global _harvest_enabled
+    _harvest_enabled = bool(on)
+
+
+def harvest_enabled() -> bool:
+    return _harvest_enabled
+
+
+def bump_dispatch_epoch() -> None:
+    """Called by the resilience supervisor before a retry / CPU-fallback
+    re-dispatch: the re-executed unit may compile a different executable
+    (other device, fresh buffers), so its harvest must not be served from
+    the pre-failure entry."""
+    global _dispatch_epoch
+    with _harvest_lock:
+        _dispatch_epoch += 1
+
+
+def reset_harvests() -> None:
+    """Start-of-run reset (cli main / bench worker), one process == one
+    run, same as the span registry."""
+    global _dispatch_epoch, _harvest_failures
+    with _harvest_lock:
+        _harvests.clear()
+        _dispatch_epoch = 0
+        _harvest_failures = 0
+
+
+def _leaf_spec(leaf) -> str:
+    shp = getattr(leaf, "shape", None)
+    dt = getattr(leaf, "dtype", None)
+    if shp is not None and dt is not None:
+        return f"{dt}{tuple(shp)}"
+    return repr(leaf)
+
+
+def _analyze_compiled(compiled) -> dict:
+    """Flatten Compiled.cost_analysis()/memory_analysis() into the
+    harvest record schema (missing analyses -> zeros, never a crash)."""
+    rec = {"flops": 0.0, "transcendentals": 0.0, "bytes_accessed": 0.0,
+           "argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0,
+           "alias_bytes": 0, "generated_code_bytes": 0}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        rec["flops"] = float(ca.get("flops", 0.0))
+        rec["transcendentals"] = float(ca.get("transcendentals", 0.0))
+        rec["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+    except Exception:  # pragma: no cover - backend-dependent
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            rec["argument_bytes"] = int(ma.argument_size_in_bytes)
+            rec["output_bytes"] = int(ma.output_size_in_bytes)
+            rec["temp_bytes"] = int(ma.temp_size_in_bytes)
+            rec["alias_bytes"] = int(ma.alias_size_in_bytes)
+            rec["generated_code_bytes"] = int(
+                ma.generated_code_size_in_bytes)
+    except Exception:  # pragma: no cover - backend-dependent
+        pass
+    return rec
+
+
+def harvest_dispatch(site: str, jitted, args: tuple) -> None:
+    """Harvest one engine dispatch (call BEFORE the real jit call — the
+    engine donates its state buffers, and ``lower`` only reads avals).
+
+    ``site`` labels the call site (``engine/run_rounds``, ...); the
+    harvest key is (site, dispatch epoch, every arg's abstract spec) —
+    exactly the information that selects a compile-cache entry, so warm
+    calls with the same signature reuse the stored record and pay one
+    dict lookup.  Any failure is counted and swallowed: the harvest must
+    never kill a run."""
+    global _harvest_failures
+    if not _harvest_enabled:
+        return
+    import jax
+    key = (site, _dispatch_epoch) + tuple(
+        _leaf_spec(leaf) for leaf in jax.tree_util.tree_leaves(args))
+    with _harvest_lock:
+        rec = _harvests.get(key)
+        if rec is not None:
+            rec["reused"] += 1
+            get_registry().add("capacity/harvest_reused", 1)
+            return
+    t0 = time.perf_counter()
+    try:
+        compiled = jitted.lower(*args).compile()
+        rec = _analyze_compiled(compiled)
+    except Exception as e:  # pragma: no cover - must never kill a run
+        with _harvest_lock:
+            _harvest_failures += 1
+        get_registry().add("capacity/harvest_failures", 1)
+        import logging
+        logging.getLogger(__name__).warning(
+            "WARNING: capacity cost harvest failed for %s (%s); "
+            "continuing unharvested", site, e)
+        return
+    rec.update({"site": site, "reused": 0,
+                "harvest_compile_s": round(time.perf_counter() - t0, 3)})
+    with _harvest_lock:
+        _harvests[key] = rec
+    reg = get_registry()
+    reg.add("capacity/harvests", 1)
+    reg.record("capacity/harvest_compile", rec["harvest_compile_s"])
+
+
+def harvest_summary() -> dict:
+    """Aggregate view for the run report / BENCH lines: totals across
+    the distinct harvested executables, peaks for the memory-shaped
+    numbers (temp/argument/output are per-executable footprints — their
+    max is the planning-relevant figure), and the per-site records."""
+    with _harvest_lock:
+        recs = [dict(r) for r in _harvests.values()]
+        failures = _harvest_failures
+    out = {
+        "enabled": _harvest_enabled,
+        "harvests": len(recs),
+        "reused": int(sum(r["reused"] for r in recs)),
+        "failures": int(failures),
+        "flops": float(sum(r["flops"] for r in recs)),
+        "transcendentals": float(sum(r["transcendentals"] for r in recs)),
+        "bytes_accessed": float(sum(r["bytes_accessed"] for r in recs)),
+        "peak_temp_bytes": int(max((r["temp_bytes"] for r in recs),
+                                   default=0)),
+        "peak_argument_bytes": int(max((r["argument_bytes"] for r in recs),
+                                       default=0)),
+        "peak_output_bytes": int(max((r["output_bytes"] for r in recs),
+                                     default=0)),
+        "generated_code_bytes": int(max(
+            (r["generated_code_bytes"] for r in recs), default=0)),
+        "sites": {},
+    }
+    for i, r in enumerate(sorted(recs, key=lambda r: (r["site"],
+                                                      -r["temp_bytes"]))):
+        out["sites"][f"{r['site']}#{i}"] = r
+    return out
+
+
+def site_peaks(site: str) -> dict:
+    """Max temp/argument/output bytes over harvests at exactly ``site``
+    (bench.py's per-rung attribution).  Exact match — a prefix would
+    silently fold ``engine/run_rounds_lanes`` into ``engine/run_rounds``."""
+    with _harvest_lock:
+        recs = [r for r in _harvests.values() if r["site"] == site]
+    return {
+        "temp_bytes": int(max((r["temp_bytes"] for r in recs), default=0)),
+        "output_bytes": int(max((r["output_bytes"] for r in recs),
+                                default=0)),
+        "argument_bytes": int(max((r["argument_bytes"] for r in recs),
+                                  default=0)),
+        "flops": float(max((r["flops"] for r in recs), default=0.0)),
+        "harvests": len(recs),
+    }

@@ -1,0 +1,257 @@
+"""Live memory-footprint sampler (``--memwatch-interval-s``).
+
+A low-overhead daemon thread polling, at a configurable interval:
+
+* **host RSS** — ``/proc/self/statm`` (one read + split, ~10 us; falls
+  back to ``resource.getrusage`` where /proc is absent), and
+* **device memory** — ``jax.local_devices()[i].memory_stats()``
+  ``bytes_in_use`` where the backend reports it (TPU/GPU; CPU returns
+  None and is skipped).  JAX is only consulted when the process already
+  imported it — the sampler never forces a backend up on its own (the
+  obs/report.py discipline).
+
+Recorded per run: the peak and a bounded, auto-decimating time series
+(when the buffer fills, every other sample is dropped and the keep
+stride doubles — a 10-hour run still fits ``max_series`` points).  The
+kernel's own high-water mark (``VmHWM`` / ``ru_maxrss``) rides along in
+every snapshot, so run reports carry a true peak-RSS figure even when
+the sampler never ran.
+
+Thread-discipline follows obs/spans.py: one lock around the aggregate
+state, samples never raise into the run, ``stop()`` joins the thread.
+The module-level singleton is what the CLI and bench share (one process
+== one run); tests construct private instances.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+#: persistent /proc/self/statm fd: opening a procfs file costs ~0.8 ms
+#: CPU in sandboxed kernels while an os.pread on a kept-open fd is
+#: ~30 us — the difference between a <0.2% and a >15% sampler duty
+#: cycle at a 20 ms interval.  /proc/self never goes stale.
+_statm_fd: int | None = None
+
+
+def rss_bytes() -> int:
+    """Current resident set size of this process, in bytes (0 if
+    unreadable — never raises)."""
+    global _statm_fd
+    try:
+        if _statm_fd is None:
+            _statm_fd = os.open("/proc/self/statm", os.O_RDONLY)
+        return int(os.pread(_statm_fd, 128, 0).split()[1]) * _PAGE
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return int(ru.ru_maxrss) * 1024  # peak, but better than 0
+    except Exception:
+        return 0
+
+
+def peak_rss_bytes() -> int:
+    """Kernel high-water-mark RSS (``VmHWM``; ``ru_maxrss`` fallback).
+    Exact and free — no sampling needed for the peak itself."""
+    try:
+        with open("/proc/self/status", "rb") as f:
+            for line in f:
+                if line.startswith(b"VmHWM:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return int(ru.ru_maxrss) * 1024
+    except Exception:
+        return 0
+
+
+#: None = not yet probed; [] = devices keep no memory stats (CPU — skip
+#: polling forever); list = the stat-bearing devices to poll.  Resolving
+#: ``jax.local_devices()`` costs milliseconds per call, so probing once
+#: is what keeps the per-sample cost at a /proc read (the <2% exact-
+#: accounting bound tools/capacity_smoke.py enforces).
+_stat_devices: list | None = None
+
+
+def device_memory_bytes() -> int:
+    """Sum of ``bytes_in_use`` across local devices, 0 where the backend
+    keeps no stats (CPU) or JAX never came up.  Never initializes a
+    backend: consulted only when jax is already imported; the device
+    list is probed once per process."""
+    global _stat_devices
+    if "jax" not in sys.modules:
+        return 0
+    try:
+        if _stat_devices is None:
+            import jax
+            _stat_devices = [
+                d for d in jax.local_devices()
+                if hasattr(d, "memory_stats") and d.memory_stats()]
+        total = 0
+        for d in _stat_devices:
+            stats = d.memory_stats()
+            if stats:
+                total += int(stats.get("bytes_in_use", 0))
+        return total
+    except Exception:  # pragma: no cover - backend-dependent
+        return 0
+
+
+class MemWatch:
+    """The sampler thread + bounded series store."""
+
+    def __init__(self, interval_s: float = 0.5, max_series: int = 512):
+        self.interval_s = max(0.005, float(interval_s))
+        self.max_series = max(16, int(max_series))
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._series: list = []          # [(t_rel, rss_bytes), ...]
+        self._stride = 1                 # decimation: keep 1-in-stride
+        self._tick = 0
+        self._samples = 0
+        self._peak_rss = 0
+        self._peak_device = 0
+        self._last_rss = 0
+        self._sample_time_s = 0.0
+        self._t0 = time.perf_counter()
+
+    # -- sampling ---------------------------------------------------------
+
+    def sample_once(self) -> int:
+        """Take one sample (also usable without the thread); returns the
+        sampled RSS.  ``sample_time_s`` accumulates thread CPU time
+        (``time.thread_time``), not wall — under a saturated box the
+        sampler's wall includes GIL/scheduler waits that cost the run
+        nothing, and the <2% overhead bound is about CPU actually
+        consumed.  The CPU clock itself is a slow syscall in sandboxed
+        kernels (~0.3 ms contended — several times the sample it
+        measures), so self-timing runs on every 8th sample and scales
+        by 8: the accounting stays honest while the act of measuring
+        stops dominating the cost being measured."""
+        measure = (self._tick & 7) == 0
+        t0 = time.thread_time() if measure else 0.0
+        wall0 = time.perf_counter()
+        rss = rss_bytes()
+        dev = device_memory_bytes()
+        with self._lock:
+            self._samples += 1
+            self._last_rss = rss
+            self._peak_rss = max(self._peak_rss, rss)
+            self._peak_device = max(self._peak_device, dev)
+            if self._tick % self._stride == 0:
+                self._series.append((round(wall0 - self._t0, 3), rss))
+                if len(self._series) >= self.max_series:
+                    # decimate: drop every other point, double the stride
+                    self._series = self._series[::2]
+                    self._stride *= 2
+            self._tick += 1
+            if measure:
+                self._sample_time_s += (time.thread_time() - t0) * 8
+        return rss
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:  # pragma: no cover - must never kill a run
+                pass
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "MemWatch":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._t0 = time.perf_counter()
+        self.sample_once()               # a run is never sample-free
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="memwatch")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        self.sample_once()               # close the series at stop time
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None
+
+    # -- reporting --------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-safe state for the run report's ``capacity.memwatch``
+        section.  ``peak_rss_bytes`` is the max of the sampled peak and
+        the kernel high-water mark, so it is nonzero (and honest) even
+        when the sampler never ran."""
+        kernel_peak = peak_rss_bytes()
+        with self._lock:
+            return {
+                "enabled": self._thread is not None or self._samples > 0,
+                "interval_s": self.interval_s if self._samples > 1 else 0.0,
+                "samples": self._samples,
+                "peak_rss_bytes": max(self._peak_rss, kernel_peak),
+                "sampled_peak_rss_bytes": self._peak_rss,
+                "kernel_peak_rss_bytes": kernel_peak,
+                "last_rss_bytes": self._last_rss or rss_bytes(),
+                "peak_device_bytes": self._peak_device,
+                "sample_time_s": round(self._sample_time_s, 6),
+                "series_stride": self._stride,
+                "rss_series": [list(p) for p in self._series],
+            }
+
+
+_SINGLETON = MemWatch()
+
+
+def get_memwatch() -> MemWatch:
+    """The process-wide sampler (one process == one run)."""
+    return _SINGLETON
+
+
+def reset() -> None:
+    """One process == one run (the span-registry discipline): stop any
+    sampler a previous in-process run left behind — including one leaked
+    by an early-exit path — and drop its series, so the next run's
+    snapshots never carry another run's data."""
+    global _SINGLETON
+    _SINGLETON.stop()
+    _SINGLETON = MemWatch()
+
+
+def start(interval_s: float) -> MemWatch:
+    """Start (or retune + start) the shared sampler."""
+    global _SINGLETON
+    if _SINGLETON.running:
+        return _SINGLETON
+    if _SINGLETON._samples:
+        _SINGLETON = MemWatch(interval_s)   # fresh series per run
+    else:
+        _SINGLETON.interval_s = max(0.005, float(interval_s))
+    return _SINGLETON.start()
+
+
+def stop() -> None:
+    _SINGLETON.stop()
+
+
+def snapshot() -> dict:
+    """Snapshot of the shared sampler — safe (and meaningful: kernel
+    peak + current RSS) even when no sampler ever started."""
+    return _SINGLETON.snapshot()

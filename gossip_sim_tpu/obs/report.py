@@ -18,6 +18,16 @@ span registry, plus nested sections the bench's one-liner omits:
 * ``influx``       — points sent / dropped / retries / final queue depth
 * ``compilation_cache`` — persistent XLA cache dir + hit/miss counts
                      (engine/cache.py; all-zero when never enabled)
+* ``capacity``     — the capacity observatory (obs/capacity.py,
+                     obs/memwatch.py; ISSUE 13): the closed-form memory
+                     ``ledger`` the run path stamped into registry info,
+                     the XLA ``cost`` harvest summary (FLOPs,
+                     argument/output/temp/generated-code bytes, keyed by
+                     compile-cache entry), and the ``memwatch`` footprint
+                     snapshot (peak/series RSS, device bytes-in-use).
+                     The memwatch peak is always nonzero (kernel VmHWM);
+                     ledger/cost fill in when a run path computed them /
+                     ``--capacity-harvest`` was on
 
 Compile-accounting counters (engine/core.py run_rounds; ISSUE 4):
 
@@ -100,6 +110,7 @@ REQUIRED_KEYS = {
     "stats": dict,
     "compilation_cache": dict,
     "resilience": dict,
+    "capacity": dict,
 }
 
 
@@ -229,6 +240,7 @@ def build_run_report(config, registry, *, stats: dict | None = None,
         "influx": dict(influx or {}),
         "stats": dict(stats or {}),
         "compilation_cache": _compilation_cache_section(info),
+        "capacity": _capacity_section(info),
         # resilient-execution accounting (resilience.py): journal units
         # committed this run, units replayed from a prior run's journal,
         # supervised dispatch failures and CPU-fallback re-executions —
@@ -245,6 +257,23 @@ def build_run_report(config, registry, *, stats: dict | None = None,
         },
     })
     return report
+
+
+def _capacity_section(info: dict) -> dict:
+    """Capacity-observatory section (obs/capacity.py + obs/memwatch.py):
+    the static ledger the run path stamped into registry info, the XLA
+    cost-harvest summary and the live-footprint snapshot.  A report must
+    never die on a telemetry subsystem, so failures collapse to empty
+    subsections."""
+    try:
+        from . import capacity, memwatch
+        return {
+            "ledger": dict(info.get("capacity_ledger") or {}),
+            "cost": capacity.harvest_summary(),
+            "memwatch": memwatch.snapshot(),
+        }
+    except Exception:  # pragma: no cover - report must never kill a run
+        return {"ledger": {}, "cost": {}, "memwatch": {}}
 
 
 def _compilation_cache_section(info: dict) -> dict:

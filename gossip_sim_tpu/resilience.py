@@ -614,6 +614,17 @@ def _call_with_timeout(fn, timeout_s: float, label: str):
     return result[0]
 
 
+def _bump_capacity_epoch() -> None:
+    """Invalidate the capacity cost harvest's compile-entry keys after a
+    failed dispatch (see supervised_call).  Never lets telemetry break
+    the recovery path."""
+    try:
+        from .obs import capacity
+        capacity.bump_dispatch_epoch()
+    except Exception:  # pragma: no cover
+        pass
+
+
 def supervised_call(label: str, attempt_fn, policy: DispatchPolicy,
                     cpu_fallback=None):
     """Run one engine unit under the watchdog/retry/fallback policy.
@@ -638,6 +649,11 @@ def supervised_call(label: str, attempt_fn, policy: DispatchPolicy,
                 raise
             last = e
             reg.add("resilience/device_failures", 1)
+            # the re-dispatch may compile a fresh executable (new buffers,
+            # possibly another device): invalidate the capacity cost
+            # harvest's compile-cache keying so the re-run re-harvests
+            # (obs/capacity.py) instead of reusing the pre-failure entry
+            _bump_capacity_epoch()
             if attempt < policy.retries:
                 log.warning("device dispatch '%s' failed (attempt %s/%s): "
                             "%s — retrying in %.2fs", label, attempt + 1,
@@ -649,6 +665,7 @@ def supervised_call(label: str, attempt_fn, policy: DispatchPolicy,
                     "re-executing the unit on the CPU fallback path",
                     label, policy.retries + 1)
         reg.add("resilience/fallback_units", 1)
+        _bump_capacity_epoch()
         # the fault hook injects *device* failures; the fallback arm runs
         # clean, as a healthy CPU re-execution would
         return cpu_fallback()

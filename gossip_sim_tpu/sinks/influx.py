@@ -17,8 +17,10 @@ Series and field names are the compatibility contract
 Extensions beyond the reference: ``delivery`` / ``coverage_recovery``
 (fault injection, faults.py), ``sim_perf`` (runtime telemetry, obs/:
 round-block wall time, throughput, sender queue depth), ``sim_trace``
-(flight-recorder segment flushes, obs/trace.py) and ``sim_pull``
-(pull-phase request/response/miss/rescue counters, pull.py).
+(flight-recorder segment flushes, obs/trace.py), ``sim_pull``
+(pull-phase request/response/miss/rescue counters, pull.py) and
+``sim_capacity`` (memory/FLOP footprint: ledger totals, peak RSS, XLA
+temp bytes — obs/capacity.py, obs/memwatch.py).
 """
 
 from __future__ import annotations
@@ -82,15 +84,17 @@ class DatapointQueue:
     def drain_deterministic_lines(self) -> list:
         """Drain the queue into its deterministic wire payload: every line
         with the per-point ns timestamp (the trailing token) stripped and
-        the wall-clock-valued ``sim_perf`` series dropped.  This is THE
-        normalized form two runs of the same simulation must agree on —
-        the lane-sweep parity tests and tools/lane_smoke.py both diff it,
-        so the Influx bit-exactness contract has one definition."""
+        the wall-clock-valued series (``sim_perf``, ``sim_capacity``)
+        dropped.  This is THE normalized form two runs of the same
+        simulation must agree on — the lane-sweep parity tests and
+        tools/lane_smoke.py both diff it, so the Influx bit-exactness
+        contract has one definition."""
         lines = []
         while len(self):
             dp = self.pop_front()
             for ln in dp.data().splitlines():
-                if not ln or ln.startswith("sim_perf"):
+                if (not ln or ln.startswith("sim_perf")
+                        or ln.startswith("sim_capacity")):
                     continue
                 lines.append(ln.rsplit(" ", 1)[0])
         return lines
@@ -368,6 +372,23 @@ class InfluxDataPoint:
             f"sim_adaptive,simulation_iter={self.simulation_iteration},"
             f"start_time={self.start_timestamp} "
             f"iteration={int(it)},{fields} ")
+        self.append_timestamp()
+
+    def create_sim_capacity_point(self, values: dict):
+        """Capacity-observatory series (obs/capacity.py + obs/memwatch.py):
+        one end-of-run point — ledger totals (bytes, bytes/node, dense
+        N^2 share), peak host RSS / device bytes-in-use, and the XLA
+        cost-harvest peaks (temp/argument/output bytes, FLOPs).  Carries
+        wall-clock-dependent values (RSS), so drain_deterministic_lines
+        drops it alongside sim_perf — enabling capacity never moves a
+        bit on the parity wire surface."""
+        parts = []
+        for k, v in sorted(values.items()):
+            parts.append(f"{k}={float(v)}" if isinstance(v, float)
+                         else f"{k}={int(v)}")
+        self.datapoint += (
+            f"sim_capacity,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} " + ",".join(parts) + " ")
         self.append_timestamp()
 
     def create_messages_point(self, messages_direction: str, messages,

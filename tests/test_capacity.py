@@ -1,0 +1,312 @@
+"""Capacity observatory (obs/capacity.py + obs/memwatch.py, ISSUE 13).
+
+The load-bearing contract is *exactness*: the closed-form ledger must
+predict the live donated-buffer pytree bytes bit-for-bit, at more than
+one (N, S, M) point, so its N-scaling extrapolations (capacity_report,
+fit-budget) are arithmetic rather than estimates.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                   make_cluster_tables, run_rounds)
+from gossip_sim_tpu.engine.lanes import (broadcast_state, run_rounds_lanes,
+                                         stack_knobs)
+from gossip_sim_tpu.engine.traffic import (device_traffic_tables,
+                                           init_traffic_state,
+                                           run_traffic_rounds)
+from gossip_sim_tpu.obs import capacity, memwatch
+from gossip_sim_tpu.obs.report import build_run_report, validate_run_report
+from gossip_sim_tpu.obs.spans import SpanRegistry
+
+
+def synth_stakes(n, seed=3):
+    rng = np.random.default_rng(seed)
+    return (np.exp(rng.normal(9.5, 2.0, n)).astype(np.int64) + 1) * 10 ** 9
+
+
+# --------------------------------------------------------------------------
+# ledger exactness (the satellite contract: two (N, S, M) points + the
+# closed-form extrapolation matching a second live instantiation)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,o,s", [(64, 1, 12), (150, 3, 8)])
+def test_sim_state_ledger_exact(n, o, s):
+    params = EngineParams(num_nodes=n, active_set_size=s)
+    tables = make_cluster_tables(synth_stakes(n))
+    origins = jnp.arange(o, dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+    live, _ = capacity.measure_pytree(state)
+    assert capacity.predict_sim_state_bytes(params, o) == live
+
+
+@pytest.mark.parametrize("mode", ["push", "push-pull", "adaptive"])
+def test_sim_state_ledger_exact_across_modes(mode):
+    # SimState geometry is mode-invariant (the pull accumulators always
+    # exist); the ledger must agree under every gossip mode
+    params = EngineParams(num_nodes=80, gossip_mode=mode)
+    tables = make_cluster_tables(synth_stakes(80))
+    origins = jnp.asarray([0], dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(1), tables, origins, params)
+    state, _ = run_rounds(params, tables, origins, state, 2)
+    live, _ = capacity.measure_pytree(state)
+    assert capacity.predict_sim_state_bytes(params, 1) == live
+
+
+@pytest.mark.parametrize("n,m", [(64, 4), (100, 9)])
+def test_traffic_state_ledger_exact(n, m):
+    params = EngineParams(num_nodes=n, traffic_values=m,
+                          node_ingress_cap=8, node_egress_cap=8,
+                          warm_up_rounds=0)
+    stakes = synth_stakes(n)
+    state = init_traffic_state(stakes, params, seed=0)
+    state, _ = run_traffic_rounds(params, make_cluster_tables(stakes),
+                                  device_traffic_tables(stakes), state, 2)
+    live, _ = capacity.measure_pytree(state)
+    assert capacity.predict_traffic_state_bytes(params) == live
+
+
+def test_lane_state_ledger_exact():
+    K = 3
+    params = EngineParams(num_nodes=96)
+    tables = make_cluster_tables(synth_stakes(96))
+    origins = jnp.asarray([0], dtype=jnp.int32)
+    base = init_state(jax.random.PRNGKey(0), tables, origins, params)
+    knobs = stack_knobs([params._replace(
+        probability_of_rotation=0.01 + 0.001 * k).knob_values()
+        for k in range(K)])
+    states, _ = run_rounds_lanes(params.static_part(), tables, origins,
+                                 broadcast_state(base, K), knobs, 1)
+    live, _ = capacity.measure_pytree(states)
+    assert capacity.predict_sim_state_bytes(params, 1, lanes=K) == live
+
+
+def test_extrapolation_matches_second_live_instantiation():
+    # the SAME closed forms evaluated at a different N must equal a live
+    # instantiation there — extrapolation is exact, not a fit
+    params = EngineParams(num_nodes=64)
+    n2 = 131
+    p2 = params._replace(num_nodes=n2)
+    tables2 = make_cluster_tables(synth_stakes(n2))
+    origins = jnp.asarray([0], dtype=jnp.int32)
+    state2 = init_state(jax.random.PRNGKey(0), tables2, origins, p2)
+    live2, _ = capacity.measure_pytree(state2)
+    assert capacity.predict_sim_state_bytes(p2, 1) == live2
+    # and through the ledger_total_at path (state + tables + knobs)
+    tables_live, _ = capacity.measure_pytree(tables2)
+    knobs_live, _ = capacity.measure_pytree(p2.knob_values())
+    assert capacity.ledger_total_at(params, n2) == (live2 + tables_live
+                                                    + knobs_live)
+
+
+def test_tables_and_knobs_exact():
+    params = EngineParams(num_nodes=77)
+    tables = make_cluster_tables(synth_stakes(77))
+    live, _ = capacity.measure_pytree(tables)
+    assert sum(e.bytes
+               for e in capacity.cluster_tables_entries(params)) == live
+    klive, _ = capacity.measure_pytree(params.knob_values())
+    assert sum(e.bytes for e in capacity.knobs_entries()) == klive
+
+
+def test_trace_block_rounds_matches_cli_harvest_block():
+    from gossip_sim_tpu.cli import HARVEST_BLOCK
+    assert capacity.TRACE_BLOCK_ROUNDS == HARVEST_BLOCK
+
+
+# --------------------------------------------------------------------------
+# ledger structure + planning queries
+# --------------------------------------------------------------------------
+
+def test_ledger_flags_dense_terms_only_under_all_origins():
+    params = EngineParams(num_nodes=500)
+    single = capacity.capacity_ledger(params, origin_batch=1)
+    assert [e for e in single["entries"]
+            if e["exact"] and e["n_degree"] >= 2] == []
+    allo = capacity.capacity_ledger(params, origin_batch=500,
+                                    origins_scale_with_n=True)
+    dense = [e["name"] for e in allo["entries"]
+             if e["exact"] and e["n_degree"] >= 2]
+    assert "active" in dense and "rc_src" in dense
+    assert allo["dense_terms"]
+    assert allo["dense_bytes"] > 0
+
+
+def test_ledger_is_json_safe_and_grouped():
+    led = capacity.capacity_ledger(EngineParams(num_nodes=200),
+                                   origin_batch=2, trace=True)
+    json.dumps(led)
+    assert led["schema"] == capacity.CAPACITY_SCHEMA
+    for group in ("active-set", "received-cache", "stats", "tables",
+                  "knobs", "trace"):
+        assert led["groups"][group] > 0
+    # exact group totals re-sum to the total
+    assert sum(led["groups"].values()) == led["total_bytes"]
+    assert led["bytes_per_node"] == pytest.approx(led["total_bytes"] / 200,
+                                                  abs=0.01)
+
+
+def test_fit_budget_is_tight():
+    params = EngineParams(num_nodes=100)
+    budget = capacity.parse_size("64MiB")
+    n = capacity.fit_budget(params, budget)
+    assert capacity.ledger_total_at(params, n) <= budget
+    assert capacity.ledger_total_at(params, n + 1) > budget
+
+
+def test_fit_budget_all_origins_is_quadratically_smaller():
+    params = EngineParams(num_nodes=100)
+    budget = capacity.parse_size("1GiB")
+    n_single = capacity.fit_budget(params, budget)
+    n_all = capacity.fit_budget(params, budget,
+                                origins_scale_with_n=True)
+    assert 0 < n_all < n_single
+
+
+def test_parse_size():
+    assert capacity.parse_size("16GB") == 16 * 2 ** 30
+    assert capacity.parse_size("512MiB") == 512 * 2 ** 20
+    assert capacity.parse_size("2e3") == 2000
+    assert capacity.parse_size(1234) == 1234
+    assert capacity.parse_size("1k") == 1000
+
+
+# --------------------------------------------------------------------------
+# XLA cost harvest
+# --------------------------------------------------------------------------
+
+def test_harvest_disabled_is_a_noop():
+    capacity.reset_harvests()
+    capacity.set_harvest_enabled(False)
+    f = jax.jit(lambda x: x * 2)
+    capacity.harvest_dispatch("test/site", f, (jnp.ones(4),))
+    assert capacity.harvest_summary()["harvests"] == 0
+
+
+def test_harvest_keyed_reuse_and_epoch():
+    capacity.reset_harvests()
+    capacity.set_harvest_enabled(True)
+    try:
+        f = jax.jit(lambda x: (x * 2).sum())
+        args = (jnp.ones(8),)
+        capacity.harvest_dispatch("test/site", f, args)
+        capacity.harvest_dispatch("test/site", f, args)   # same key
+        s = capacity.harvest_summary()
+        assert s["harvests"] == 1 and s["reused"] == 1
+        assert s["flops"] >= 0
+        assert s["peak_argument_bytes"] == jnp.ones(8).nbytes
+        # a different signature is a new compile-cache entry
+        capacity.harvest_dispatch("test/site", f, (jnp.ones(16),))
+        assert capacity.harvest_summary()["harvests"] == 2
+        # a supervisor re-dispatch invalidates the keying (resilience.py)
+        capacity.bump_dispatch_epoch()
+        capacity.harvest_dispatch("test/site", f, args)
+        s = capacity.harvest_summary()
+        assert s["harvests"] == 3 and s["failures"] == 0
+        assert capacity.site_peaks("test/site")["harvests"] == 3
+    finally:
+        capacity.set_harvest_enabled(False)
+        capacity.reset_harvests()
+
+
+def test_harvest_through_run_rounds_matches_live_bytes():
+    # the engine hook harvests the real executable: its argument bytes
+    # must cover the state the ledger predicts (state is one of the args)
+    capacity.reset_harvests()
+    capacity.set_harvest_enabled(True)
+    try:
+        params = EngineParams(num_nodes=64)
+        tables = make_cluster_tables(synth_stakes(64))
+        origins = jnp.asarray([0], dtype=jnp.int32)
+        state = init_state(jax.random.PRNGKey(0), tables, origins, params)
+        state, _ = run_rounds(params, tables, origins, state, 2)
+        s = capacity.harvest_summary()
+        assert s["harvests"] == 1 and s["failures"] == 0
+        peaks = capacity.site_peaks("engine/run_rounds")
+        assert peaks["argument_bytes"] >= capacity.predict_sim_state_bytes(
+            params, 1)
+    finally:
+        capacity.set_harvest_enabled(False)
+        capacity.reset_harvests()
+
+
+# --------------------------------------------------------------------------
+# memwatch
+# --------------------------------------------------------------------------
+
+def test_rss_and_peak_nonzero():
+    assert memwatch.rss_bytes() > 0
+    assert memwatch.peak_rss_bytes() >= memwatch.rss_bytes() // 2
+
+
+def test_memwatch_samples_and_snapshot():
+    mw = memwatch.MemWatch(0.01)
+    mw.start()
+    time.sleep(0.08)
+    mw.stop()
+    snap = mw.snapshot()
+    assert snap["samples"] >= 3
+    assert snap["peak_rss_bytes"] > 0
+    assert snap["last_rss_bytes"] > 0
+    assert snap["rss_series"] and len(snap["rss_series"][0]) == 2
+    json.dumps(snap)
+
+
+def test_memwatch_series_decimates_bounded():
+    mw = memwatch.MemWatch(0.001, max_series=32)
+    for _ in range(200):
+        mw.sample_once()
+    snap = mw.snapshot()
+    assert snap["samples"] == 200
+    assert len(snap["rss_series"]) < 32
+    assert snap["series_stride"] > 1
+
+
+def test_memwatch_module_reset_drops_previous_run():
+    # one process == one run: a later run must never report an earlier
+    # run's sampler series (cli main() resets alongside the registry)
+    memwatch.start(0.01)
+    time.sleep(0.03)
+    memwatch.stop()
+    assert memwatch.snapshot()["samples"] > 0
+    memwatch.reset()
+    snap = memwatch.snapshot()
+    assert snap["samples"] == 0 and snap["enabled"] is False
+    assert snap["peak_rss_bytes"] > 0   # kernel mark survives, honestly
+
+
+def test_module_snapshot_without_start_carries_kernel_peak():
+    snap = memwatch.snapshot()
+    assert snap["peak_rss_bytes"] > 0
+    json.dumps(snap)
+
+
+# --------------------------------------------------------------------------
+# report integration
+# --------------------------------------------------------------------------
+
+def test_run_report_capacity_section():
+    reg = SpanRegistry()
+    reg.set_info("platform", "cpu")
+    reg.set_info("num_nodes", 40)
+    led = capacity.capacity_ledger(EngineParams(num_nodes=40))
+    reg.set_info("capacity_ledger", led)
+
+    from gossip_sim_tpu.config import Config
+    report = build_run_report(Config(gossip_iterations=4), reg)
+    assert validate_run_report(report) == []
+    cap = report["capacity"]
+    assert cap["ledger"]["total_bytes"] == led["total_bytes"]
+    assert cap["memwatch"]["peak_rss_bytes"] > 0
+    assert "harvests" in cap["cost"]
+    json.dumps(report)

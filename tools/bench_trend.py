@@ -1,0 +1,165 @@
+"""BENCH trajectory differ: the nine-plus BENCH_r*.json files as one table.
+
+The BENCH rounds accumulate one JSON file per PR (BENCH_r01..r08 at the
+time of writing) and the trajectory had to be eyeballed across them.
+This tool loads every round, prints each tracked metric's trajectory
+with per-round deltas, and flags regressions worse than ``--threshold``
+(default 10%) against the previous round that carried the metric.
+
+Rounds measured on different platforms are not comparable (r01-r03 ran
+on CPU fallback semantics before the probe cache; an eventual TPU round
+will re-baseline everything): a platform change is annotated as a BREAK,
+and deltas across it are reported but never flagged as regressions.
+
+Usage: python tools/bench_trend.py [--dir .] [--threshold 0.10]
+       [--metrics value,sweep_steps_per_sec,...] [--fail-on-regression]
+
+Exit code: 0 (report only) unless --fail-on-regression and at least one
+same-platform regression was flagged.
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: (metric key path, higher_is_better) — dotted paths reach into nested
+#: rung dicts; missing keys simply skip the round
+DEFAULT_METRICS = [
+    ("value", True),                        # origin_iters_per_sec
+    ("compile_s", False),
+    ("init_s", False),
+    ("sweep_steps_per_sec", True),
+    ("lane_sweep_steps_per_sec", True),
+    ("lane_sweep.vs_serial_sweep", True),
+    ("traffic_steps_per_sec", True),
+    ("traffic.values_converged_per_sec", True),
+    ("adaptive_traffic_steps_per_sec", True),
+    ("adaptive_traffic.values_rescued", True),
+    ("coverage_mean", True),
+    ("capacity.mem_bytes_per_node", False),     # BENCH_r09+ (ISSUE 13)
+    ("capacity.peak_rss_bytes", False),
+    ("capacity.xla_peak_temp_bytes", False),
+]
+
+
+def lookup(d: dict, path: str):
+    cur = d
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) \
+        and not isinstance(cur, bool) else None
+
+
+def load_rounds(directory: str) -> list:
+    files = sorted(
+        glob.glob(os.path.join(directory, "BENCH_r*.json")),
+        # basename only: an 'rN' component in --dir must not collapse
+        # every sort key onto the directory's number
+        key=lambda p: int(re.search(r"r(\d+)",
+                                    os.path.basename(p)).group(1)))
+    rounds = []
+    for path in files:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"  [skip] {os.path.basename(path)}: unreadable ({e})")
+            continue
+        if "parsed" in data and "value" not in data:
+            # r01-r05 era: the driver wrapped the worker line under
+            # "parsed" (None when every rung failed that round)
+            data = data.get("parsed") or {}
+        rounds.append((os.path.basename(path), data))
+    return rounds
+
+
+def fmt(v: float) -> str:
+    if v is None:
+        return "-"
+    if abs(v) >= 1e6:
+        return f"{v:.3g}"
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.2f}"
+    return str(int(v))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="diff metrics across BENCH_r*.json rounds")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="fractional regression flag threshold "
+                         "(default 10%%)")
+    ap.add_argument("--metrics", default="",
+                    help="comma-separated dotted metric paths overriding "
+                         "the default set; prefix a path with '-' to "
+                         "mark it lower-is-better (e.g. -compile_s)")
+    ap.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 1 when a same-platform regression beyond "
+                         "the threshold is flagged")
+    args = ap.parse_args()
+
+    rounds = load_rounds(args.dir)
+    if len(rounds) < 2:
+        print(f"need >= 2 BENCH rounds in {args.dir}, found {len(rounds)}")
+        return 0 if rounds else 1
+
+    metrics = ([(m.strip().lstrip("-"), not m.strip().startswith("-"))
+                for m in args.metrics.split(",") if m.strip()]
+               if args.metrics else DEFAULT_METRICS)
+
+    names = [re.search(r"r(\d+)", name).group(0) for name, _ in rounds]
+    platforms = [data.get("platform", "?") for _, data in rounds]
+    print("rounds:   " + "  ".join(f"{n}({p})"
+                                   for n, p in zip(names, platforms)))
+    breaks = [i for i in range(1, len(platforms))
+              if platforms[i] != platforms[i - 1]]
+    if breaks:
+        print("platform BREAKs after: "
+              + ", ".join(names[i - 1] for i in breaks)
+              + " (cross-platform deltas reported, never flagged)")
+
+    regressions = []
+    for path, higher_better in metrics:
+        series = [lookup(data, path) for _, data in rounds]
+        if all(v is None for v in series):
+            continue
+        cells = []
+        prev_val, prev_idx = None, None
+        for i, v in enumerate(series):
+            if v is None:
+                cells.append("-")
+                continue
+            cell = fmt(v)
+            if prev_val not in (None, 0):
+                delta = (v - prev_val) / abs(prev_val)
+                worse = (-delta if higher_better else delta)
+                same_platform = platforms[i] == platforms[prev_idx]
+                cell += f" ({delta:+.0%})"
+                if worse > args.threshold and same_platform:
+                    cell += " REGRESSION"
+                    regressions.append(
+                        (path, names[prev_idx], names[i], delta))
+            cells.append(cell)
+            prev_val, prev_idx = v, i
+        arrow = "^" if higher_better else "v"
+        print(f"  {path:<38}[{arrow}] " + " | ".join(cells))
+
+    if regressions:
+        print(f"\n{len(regressions)} regression(s) beyond "
+              f"{args.threshold:.0%}:")
+        for path, a, b, delta in regressions:
+            print(f"  {path}: {a} -> {b} ({delta:+.1%})")
+    else:
+        print(f"\nno same-platform regressions beyond {args.threshold:.0%}")
+    return 1 if (regressions and args.fail_on_regression) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

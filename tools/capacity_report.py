@@ -1,0 +1,206 @@
+"""Capacity planner: the ledger's closed forms answered as questions.
+
+Pure host arithmetic over obs/capacity.py — no JAX, no device, instant.
+Answers the ROADMAP item 1 planning questions directly:
+
+  * what does the current config cost per node, and which subsystem owns
+    the bytes? (the ledger table)
+  * what is the largest N that fits a memory budget?
+    (``--fit-budget 16GB``)
+  * what would n=100k / n=1M cost, and which dense terms blow up?
+    (``--project``; the O(N^2)-flagged arrays under the all-origins
+    interpretation are exactly the tables the sparse refactor removes)
+
+The all-origins interpretation (``--all-origins``, default ON — it is
+the north-star workload) scales the origin axis with N, so every
+``[O, N, ...]`` array is flagged quadratic; ``--origin-batch B`` instead
+analyzes a fixed batch (memory then scales linearly and the fit answers
+"how big a cluster fits per batch").
+
+NOTE the engine's i32 sort-key packing caps num_nodes at 32767
+(engine/core.py MAX_NODES); projections beyond it quantify the payoff of
+lifting that cap, they do not claim today's engine runs there.
+
+Usage:
+  python tools/capacity_report.py [--num-nodes 1000] [--fit-budget 16GB]
+      [--project 100000,1000000] [--all-origins | --origin-batch B]
+      [--sweep-lanes K] [--traffic-values M] [--gossip-mode MODE]
+      [--trace] [--top 12] [--json]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_sim_tpu.engine.params import EngineParams  # noqa: E402
+from gossip_sim_tpu.obs import capacity  # noqa: E402
+
+ENGINE_NODE_CAP = 32767  # engine/core.py MAX_NODES (i32 sort-key packing)
+
+
+def human(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.2f} TiB"
+
+
+def build_params(args, num_nodes: int) -> EngineParams:
+    caps = {}
+    if args.traffic_values > 1:
+        caps = dict(traffic_values=args.traffic_values,
+                    node_ingress_cap=args.node_ingress_cap,
+                    node_egress_cap=args.node_egress_cap)
+    return EngineParams(num_nodes=num_nodes,
+                        push_fanout=args.push_fanout,
+                        active_set_size=args.active_set_size,
+                        gossip_mode=args.gossip_mode, **caps)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="closed-form capacity planning over the exact memory "
+                    "ledger (obs/capacity.py)")
+    ap.add_argument("--num-nodes", type=int, default=1000)
+    ap.add_argument("--push-fanout", type=int, default=6)
+    ap.add_argument("--active-set-size", type=int, default=12)
+    ap.add_argument("--gossip-mode", default="push",
+                    choices=["push", "pull", "push-pull", "adaptive"])
+    ap.add_argument("--traffic-values", type=int, default=1,
+                    help="analyze the traffic engine with M value slots")
+    ap.add_argument("--node-ingress-cap", type=int, default=0)
+    ap.add_argument("--node-egress-cap", type=int, default=0)
+    ap.add_argument("--sweep-lanes", type=int, default=0)
+    ap.add_argument("--trace", action="store_true",
+                    help="include the flight-recorder block buffers")
+    ap.add_argument("--all-origins", dest="all_origins",
+                    action="store_true", default=None,
+                    help="origin axis tracks N (default; the web-scale "
+                         "interpretation that makes [O,N,..] terms N^2)")
+    ap.add_argument("--origin-batch", type=int, default=0,
+                    help="analyze a fixed origin batch instead of "
+                         "--all-origins")
+    ap.add_argument("--fit-budget", default="",
+                    help="byte budget, e.g. 16GB / 512MiB / 2e9: print "
+                         "the largest N that fits")
+    ap.add_argument("--project", default="100000,1000000",
+                    help="comma-separated N values to project the "
+                         "footprint at (default 100k, 1M)")
+    ap.add_argument("--top", type=int, default=12,
+                    help="ledger rows to print (largest first)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full ledger + answers as JSON")
+    args = ap.parse_args()
+
+    osn = not args.origin_batch if args.all_origins is None \
+        else args.all_origins
+    ob = args.origin_batch or (args.num_nodes if osn else 1)
+    params = build_params(args, args.num_nodes)
+    led = capacity.capacity_ledger(params, origin_batch=ob,
+                                   lanes=args.sweep_lanes,
+                                   trace=args.trace,
+                                   origins_scale_with_n=osn)
+
+    projections = []
+    for ns in args.project.split(","):
+        ns = ns.strip()
+        if not ns:
+            continue
+        n = int(float(ns))
+        total = capacity.ledger_total_at(params, n, origin_batch=ob,
+                                         lanes=args.sweep_lanes,
+                                         trace=args.trace,
+                                         origins_scale_with_n=osn)
+        projections.append({"num_nodes": n, "total_bytes": total,
+                            "bytes_per_node": round(total / n, 2),
+                            "beyond_engine_cap": n > ENGINE_NODE_CAP})
+
+    answers = {"ledger": led, "projections": projections}
+    if args.fit_budget:
+        budget = capacity.parse_size(args.fit_budget)
+        fit_n = capacity.fit_budget(params, budget, origin_batch=ob,
+                                    lanes=args.sweep_lanes,
+                                    trace=args.trace,
+                                    origins_scale_with_n=osn)
+        answers["fit_budget"] = {"budget_bytes": budget,
+                                 "budget": args.fit_budget,
+                                 "largest_n": fit_n,
+                                 "beyond_engine_cap":
+                                     fit_n > ENGINE_NODE_CAP}
+
+    if args.json:
+        print(json.dumps(answers, indent=2))
+        return 0
+
+    mode = ("all-origins (O tracks N)" if osn
+            else f"origin_batch={ob}")
+    print(f"capacity ledger: n={args.num_nodes} {mode} "
+          f"mode={args.gossip_mode}"
+          + (f" M={args.traffic_values}" if args.traffic_values > 1 else "")
+          + (f" lanes={args.sweep_lanes}" if args.sweep_lanes else "")
+          + (" +trace" if args.trace else ""))
+    print(f"  total {human(led['total_bytes'])} "
+          f"({led['bytes_per_node']} B/node); "
+          f"state {human(led['state_bytes'])}")
+    print("  by subsystem:")
+    for group, b in sorted(led["groups"].items(), key=lambda kv: -kv[1]):
+        print(f"    {group:<16} {human(b):>12}  "
+              f"{100.0 * b / max(led['total_bytes'], 1):5.1f}%")
+    rows = sorted((e for e in led["entries"] if e["exact"]),
+                  key=lambda e: -e["bytes"])[: args.top]
+    print(f"  largest arrays (top {len(rows)}):")
+    for e in rows:
+        flag = "  <-- O(N^2) DENSE" if e["n_degree"] >= 2 else ""
+        print(f"    {e['name']:<22} {human(e['bytes']):>12}  "
+              f"{e['formula']}{flag}")
+
+    # exact arrays only — the workspace rows are estimates excluded from
+    # the fit math, so they must not be named as what "blocks" a budget
+    dense = [e for e in led["entries"]
+             if e["n_degree"] >= 2 and e["exact"]]
+    ws_dense = [e for e in led["entries"]
+                if e["n_degree"] >= 2 and not e["exact"]]
+    if dense:
+        print(f"  dense O(N^2) terms under this interpretation: "
+              f"{len(dense)} arrays, {human(led['dense_bytes'])} exact"
+              + (f" (+ {len(ws_dense)} workspace sort-buffer estimates, "
+                 f"measured by the XLA temp-bytes harvest)"
+                 if ws_dense else ""))
+        print("  (these are the tables ROADMAP item 1's sparse "
+              "O(N*fanout) refactor removes)")
+
+    if projections:
+        print("  projections (closed-form, exact):")
+        for pr in projections:
+            cap_note = ("  [beyond engine cap 32767: needs the sparse "
+                        "refactor]" if pr["beyond_engine_cap"] else "")
+            print(f"    n={pr['num_nodes']:>9,}: "
+                  f"{human(pr['total_bytes']):>12} "
+                  f"({pr['bytes_per_node']} B/node){cap_note}")
+
+    if "fit_budget" in answers:
+        fb = answers["fit_budget"]
+        print(f"  fit --fit-budget {fb['budget']} "
+              f"({human(fb['budget_bytes'])}): largest N = "
+              f"{fb['largest_n']:,}"
+              + ("  [beyond engine cap 32767]"
+                 if fb["beyond_engine_cap"] else ""))
+        blocked = [pr for pr in projections
+                   if pr["num_nodes"] > fb["largest_n"]]
+        for pr in blocked:
+            over = pr["total_bytes"] / max(fb["budget_bytes"], 1)
+            top_dense = sorted(dense, key=lambda e: -e["bytes"])[:6]
+            print(f"    n={pr['num_nodes']:,} does NOT fit "
+                  f"({over:.1f}x the budget); blocking dense arrays: "
+                  + (", ".join(f"{e['name']} ({e['formula']})"
+                               for e in top_dense)
+                     if top_dense else "none flagged — linear terms "
+                     "dominate; raise the batch or shard nodes"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

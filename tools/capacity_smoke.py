@@ -1,0 +1,270 @@
+"""Capacity-observatory smoke test: the CI gate for obs/capacity.py +
+obs/memwatch.py (ISSUE 13).
+
+Fast CPU gate (~2 min) over four contracts:
+
+  1. **Ledger exactness**: the closed-form ledger predicts the live
+     donated-buffer pytree bytes BIT-EXACTLY — on a 1k-node push run
+     (post-round SimState + ClusterTables + EngineKnobs), on a
+     traffic run (TrafficState), and on a lane-batched run ([K,...]
+     states); plus the N-scaling extrapolation against a second live
+     instantiation at a different N.
+  2. **Report schema**: a CLI run with ``--capacity-harvest
+     --memwatch-interval-s`` emits a schema-valid run report whose
+     capacity section carries nonzero cost-harvest fields (harvests,
+     FLOPs, argument bytes) and a nonzero peak-RSS figure.
+  3. **Memwatch overhead** under ``--overhead-budget`` (default 2%):
+     enforced EXACTLY via the sampler's own CPU accounting
+     (``sample_time_s`` / run wall, gate 2's instrumented report), plus
+     an A/B wall-clock sanity net on the obs_smoke workload (absolute
+     slack absorbs CI timer noise on sub-second runs).
+  4. **Zero bit-impact**: enabling the harvest + sampler moves no bit of
+     the stats parity snapshot or the deterministic Influx wire lines,
+     and the ``sim_capacity`` series is excluded from the deterministic
+     wire surface (it is wall-clock-valued, like sim_perf).
+
+Usage: python tools/capacity_smoke.py [--num-nodes 1000] [--seed 7]
+       [--reps 2] [--overhead-budget 0.02] [--overhead-slack-s 0.2]
+
+Exit code 0 = all contracts hold; 1 = a capacity invariant failed.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="capacity ledger/harvest/memwatch smoke (CPU, <2min)")
+    ap.add_argument("--num-nodes", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--overhead-budget", type=float, default=0.02)
+    ap.add_argument("--overhead-slack-s", type=float, default=0.2)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from gossip_sim_tpu.cli import main as cli_main
+    from gossip_sim_tpu.cli import run_simulation
+    from gossip_sim_tpu.config import Config
+    from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                       make_cluster_tables, run_rounds)
+    from gossip_sim_tpu.engine.lanes import (broadcast_state,
+                                             run_rounds_lanes, stack_knobs)
+    from gossip_sim_tpu.engine.traffic import (device_traffic_tables,
+                                               init_traffic_state,
+                                               run_traffic_rounds)
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    from gossip_sim_tpu.obs import capacity, memwatch, validate_run_report
+    from gossip_sim_tpu.obs.spans import get_registry
+    from gossip_sim_tpu.sinks import DatapointQueue, InfluxDataPoint
+    from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+
+    t_start = time.time()
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    def stakes(n):
+        rng = np.random.default_rng(args.seed)
+        return (np.exp(rng.normal(9.5, 2.0, n)).astype(np.int64) + 1) * 10 ** 9
+
+    print(f"capacity smoke: n={args.num_nodes} seed={args.seed} "
+          f"reps={args.reps}")
+
+    # ---- gate 1: ledger exactness vs live donated buffers ---------------
+    n = args.num_nodes
+    params = EngineParams(num_nodes=n)
+    tables = make_cluster_tables(stakes(n))
+    origins = jnp.asarray([0], dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(args.seed), tables, origins,
+                       params)
+    state, _ = run_rounds(params, tables, origins, state, 2)
+    live, _ = capacity.measure_pytree(state)
+    pred = capacity.predict_sim_state_bytes(params, 1)
+    check(pred == live,
+          f"1k-node push SimState bit-exact ({pred} == {live})")
+    tlive, _ = capacity.measure_pytree(tables)
+    tpred = sum(e.bytes for e in capacity.cluster_tables_entries(params))
+    check(tpred == tlive,
+          f"ClusterTables bit-exact ({tpred} == {tlive})")
+    klive, _ = capacity.measure_pytree(params.knob_values())
+    kpred = sum(e.bytes for e in capacity.knobs_entries())
+    check(kpred == klive, f"EngineKnobs bit-exact ({kpred} == {klive})")
+
+    # extrapolation: the SAME closed forms at a different N must match a
+    # second live instantiation
+    n2 = 257
+    p2 = EngineParams(num_nodes=n2)
+    st2 = init_state(jax.random.PRNGKey(args.seed),
+                     make_cluster_tables(stakes(n2)),
+                     origins, p2)
+    live2, _ = capacity.measure_pytree(st2)
+    check(capacity.predict_sim_state_bytes(p2, 1) == live2,
+          f"closed-form N-extrapolation matches live at n={n2}")
+
+    # traffic run
+    tn, M = 300, 8
+    tparams = EngineParams(num_nodes=tn, traffic_values=M, traffic_rate=2,
+                           node_ingress_cap=24, node_egress_cap=32,
+                           warm_up_rounds=0)
+    tstakes = stakes(tn)
+    tstate = init_traffic_state(tstakes, tparams, seed=args.seed)
+    tstate, _ = run_traffic_rounds(tparams, make_cluster_tables(tstakes),
+                                   device_traffic_tables(tstakes), tstate, 3)
+    tlive2, _ = capacity.measure_pytree(tstate)
+    tpred2 = capacity.predict_traffic_state_bytes(tparams)
+    check(tpred2 == tlive2,
+          f"traffic TrafficState bit-exact at n={tn} M={M} "
+          f"({tpred2} == {tlive2})")
+
+    # lane-batched run
+    K = 3
+    lp = EngineParams(num_nodes=128)
+    lt = make_cluster_tables(stakes(128))
+    lst = init_state(jax.random.PRNGKey(args.seed), lt, origins, lp)
+    static = lp.static_part()
+    knobs = stack_knobs([lp._replace(
+        probability_of_rotation=0.01 + 0.001 * k).knob_values()
+        for k in range(K)])
+    lstates, _ = run_rounds_lanes(static, lt, origins,
+                                  broadcast_state(lst, K), knobs, 2)
+    llive, _ = capacity.measure_pytree(lstates)
+    lpred = capacity.predict_sim_state_bytes(lp, 1, lanes=K)
+    check(lpred == llive,
+          f"lane-batched [K={K}] SimState bit-exact ({lpred} == {llive})")
+
+    # ---- gate 2: run-report capacity section ----------------------------
+    report_path = f"/tmp/capacity_smoke_{os.getpid()}.json"
+    # 0.1 s = 10 Hz: sampling syscalls cost ~1 ms CPU under compile
+    # contention in sandboxed kernels, so 10 Hz keeps the sampler's own
+    # CPU comfortably inside the 2% bound while still producing a dense
+    # series (~100 points on this run)
+    rc = cli_main(["--num-synthetic-nodes", "60", "--iterations", "12",
+                   "--warm-up-rounds", "2", "--seed", str(args.seed),
+                   "--run-report", report_path, "--capacity-harvest",
+                   "--memwatch-interval-s", "0.1"])
+    check(rc == 0, "capacity-instrumented CLI run exits 0")
+    try:
+        with open(report_path) as f:
+            rep = json.load(f)
+        os.unlink(report_path)
+    except (OSError, ValueError) as e:
+        rep = {}
+        check(False, f"run report unreadable: {e}")
+    if rep:
+        check(validate_run_report(rep) == [], "report schema-valid")
+        cap = rep.get("capacity", {})
+        cost = cap.get("cost", {})
+        mem = cap.get("memwatch", {})
+        led = cap.get("ledger", {})
+        check(cost.get("harvests", 0) > 0 and cost.get("failures", 1) == 0,
+              f"cost harvest ran ({cost.get('harvests')} executables, "
+              f"{cost.get('reused')} reuses)")
+        check(cost.get("flops", 0) > 0
+              and cost.get("peak_argument_bytes", 0) > 0,
+              "cost harvest fields nonzero (flops, argument bytes)")
+        check(mem.get("peak_rss_bytes", 0) > 0
+              and mem.get("samples", 0) > 0,
+              f"memwatch peak RSS nonzero "
+              f"({mem.get('peak_rss_bytes', 0)} B, "
+              f"{mem.get('samples', 0)} samples)")
+        # the REAL <2% bound: exact sampler CPU accounting (the sampler
+        # times its own /proc reads — sample_time_s) over the run's
+        # wall, immune to the timer noise that plagues sub-second A/B
+        # wall-clock comparisons
+        wall = rep.get("throughput", {}).get("wall_s", 0)
+        frac = (mem.get("sample_time_s", 0) / wall) if wall > 0 else 1.0
+        check(frac < args.overhead_budget,
+              f"measured sampler CPU {frac * 100:.3f}% of wall "
+              f"< {args.overhead_budget:.0%} at 10 Hz (exact "
+              f"thread-CPU accounting)")
+        check(led.get("total_bytes", 0) > 0
+              and led.get("bytes_per_node", 0) > 0,
+              f"ledger stamped ({led.get('total_bytes', 0)} B total)")
+
+    # ---- gate 3: memwatch wall-clock sanity on the obs_smoke workload ---
+    # The binding <2% bound is the exact sampler-CPU check in gate 2;
+    # this A/B wall comparison is a noise-bounded end-to-end sanity net
+    # (sub-second runs need the absolute slack to absorb CI timer jitter,
+    # which makes the effective wall bound looser than 2% here).
+    base = ["--num-synthetic-nodes", "40", "--iterations", "16",
+            "--warm-up-rounds", "4", "--seed", str(args.seed)]
+
+    def timed_run(extra):
+        t0 = time.perf_counter()
+        rc = cli_main(base + extra)
+        check(rc == 0, f"overhead arm exits 0 ({extra or 'plain'})")
+        return time.perf_counter() - t0
+
+    timed_run([])  # cold: warm the jit cache for both arms
+    t_plain = min(timed_run([]) for _ in range(max(1, args.reps)))
+    t_mw = min(timed_run(["--memwatch-interval-s", "0.02"])
+               for _ in range(max(1, args.reps)))
+    overhead = (t_mw - t_plain) / t_plain if t_plain > 0 else 0.0
+    budget = t_plain * (1.0 + args.overhead_budget) + args.overhead_slack_s
+    print(f"  plain={t_plain:.3f}s memwatch={t_mw:.3f}s "
+          f"wall delta={overhead * 100:+.2f}%")
+    check(t_mw <= budget,
+          f"memwatch wall-clock sanity: within {args.overhead_budget:.0%} "
+          f"+ {args.overhead_slack_s}s timer-noise slack")
+
+    # ---- gate 4: zero bit-impact ----------------------------------------
+    def run_single(instrument: bool):
+        reset_unique_pubkeys()
+        get_registry().reset()
+        capacity.reset_harvests()
+        capacity.set_harvest_enabled(instrument)
+        mw = memwatch.MemWatch(0.01) if instrument else None
+        if mw:
+            mw.start()
+        try:
+            cfg = Config(num_synthetic_nodes=200, gossip_iterations=8,
+                         warm_up_rounds=2, seed=args.seed)
+            coll = GossipStatsCollection()
+            coll.set_number_of_simulations(1)
+            dpq = DatapointQueue()
+            run_simulation(cfg, "", coll, dpq, 0, "0", 0.0)
+            return (coll.collection[0].parity_snapshot(),
+                    dpq.drain_deterministic_lines())
+        finally:
+            if mw:
+                mw.stop()
+            capacity.set_harvest_enabled(False)
+    snap_a, wire_a = run_single(False)
+    snap_b, wire_b = run_single(True)
+    check(snap_a == snap_b, "harvest+memwatch move zero bits of the "
+                            "stats parity snapshot")
+    check(wire_a == wire_b, "harvest+memwatch move zero bits of the "
+                            "deterministic Influx wire lines")
+
+    dpq = DatapointQueue()
+    dp = InfluxDataPoint("0")
+    dp.create_sim_capacity_point({"peak_rss_bytes": 123, "x": 1.5})
+    dpq.push_back(dp)
+    check(dpq.drain_deterministic_lines() == [],
+          "sim_capacity excluded from the deterministic wire surface")
+
+    print(f"  elapsed: {time.time() - t_start:.1f}s")
+    if failures:
+        print(f"CAPACITY SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("CAPACITY SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,8 +20,17 @@ The gates, in dependency-light-first order:
                 the BENCH_r07 traffic config where push converges 0,
                 zero bit-impact at mode=push, 1k-node adaptive
                 engine-vs-oracle parity under loss+churn+caps
+  capacity_smoke capacity observatory (ISSUE 13): ledger bit-exact vs
+                live buffer bytes (push/traffic/lanes), schema-valid
+                run-report capacity section with nonzero cost-harvest +
+                peak-RSS fields, memwatch overhead < 2%, zero bit-impact
+                on parity snapshots and wire lines
 
-Usage: python tools/ci_gates.py [--only NAME[,NAME...]]
+Usage: python tools/ci_gates.py [--only NAME[,NAME...]] [--list]
+
+``--only`` runs a subset (ten serial gates take a while — pick the ones
+your change touches); ``--list`` prints the registry and exits.  The
+summary table carries each gate's wall time.
 
 Exit code 0 = every gate passed; 1 = at least one failed (each gate's
 output streams through, and a summary table prints at the end).
@@ -35,16 +44,22 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
          "pull_smoke", "lane_smoke", "resume_smoke", "traffic_smoke",
-         "adaptive_smoke"]
+         "adaptive_smoke", "capacity_smoke"]
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description="run all CI smoke gates")
     ap.add_argument("--only", default="",
                     help="comma-separated subset of gates to run")
+    ap.add_argument("--list", action="store_true",
+                    help="print the gate registry and exit")
     ap.add_argument("--timeout", type=int, default=600,
                     help="per-gate hard timeout (seconds)")
     args = ap.parse_args()
+    if args.list:
+        for gate in GATES:
+            print(gate)
+        return 0
     selected = ([g.strip() for g in args.only.split(",") if g.strip()]
                 if args.only else GATES)
     unknown = [g for g in selected if g not in GATES]
