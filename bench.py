@@ -319,6 +319,33 @@ def worker(args) -> int:
     # traffic statics — the adaptive graph is the larger of the two)
     adaptive_capacity = rung_capacity(aparams, "engine/run_traffic_rounds")
 
+    # ---- health rung: the traffic workload with the node-health planes
+    # accumulating (obs/health.py, ISSUE 17).  Identical config + seed as
+    # the traffic rung with health=True, so the warm-elapsed delta IS the
+    # plane-accumulation cost; health_overhead_pct is the number
+    # tools/bench_trend.py tracks (and tools/health_smoke.py bounds <2%).
+    hparams = tparams._replace(health=True)
+    hstate = init_traffic_state(tstakes, hparams, seed=0)
+    h0 = harvest_s()
+    t_hc = time.perf_counter()
+    hstate, hrows = run_traffic_rounds(hparams, ttables_c, tt, hstate, 3)
+    jax.block_until_ready(hrows["converged"])
+    health_compile_dt = time.perf_counter() - t_hc - (harvest_s() - h0)
+    h0 = harvest_s()
+    t_hr = time.perf_counter()
+    hstate, hrows = run_traffic_rounds(hparams, ttables_c, tt, hstate,
+                                       titers, start_it=3)
+    jax.block_until_ready(hrows["converged"])
+    health_dt = time.perf_counter() - t_hr - (harvest_s() - h0)
+    # one end-of-rung digest dispatch, timed (the per-block host harvest
+    # is [10,·]/[k,·] only — this is the whole observability hot path)
+    from gossip_sim_tpu.obs import health as health_obs
+    hstack = jnp.stack([hstate.sent_acc, hstate.recv_acc, hstate.defer_acc,
+                        hstate.qdrop_acc, hstate.health_del_acc])
+    t_dg = time.perf_counter()
+    hdig = health_obs.digest_stack(hstack, ttables_c.stake_decile, 10)
+    digest_dt = time.perf_counter() - t_dg
+
     result = bench_summary(
         reg, platform=platform, num_nodes=n, origin_batch=o,
         iterations=args.iterations,
@@ -394,6 +421,18 @@ def worker(args) -> int:
             "values_retired": a_ret - traffic_retired,
         },
         **adaptive_capacity,
+    }
+    result["health_overhead_pct"] = round(
+        100.0 * (health_dt - traffic_dt) / traffic_dt, 2) \
+        if traffic_dt > 0 else 0.0
+    result["health"] = {
+        "timed_rounds": titers,
+        "warm_elapsed_s": round(health_dt, 3),
+        "first_call_elapsed_s": round(health_compile_dt, 3),
+        "digest_s": round(digest_dt, 4),
+        "queue_dropped_total": int(np.asarray(hstate.qdrop_acc).sum()),
+        "queue_dropped_gini": health_obs.gini_value(
+            int(hdig["gini_num"][3]), int(hdig["gini_den"][3])),
     }
     # run-level capacity line (ROADMAP item 1's measured memory baseline;
     # tools/bench_trend.py tracks these across rounds)

@@ -22,7 +22,7 @@ from .engine.params import EngineParams
 
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 7
+_FORMAT_VERSION = 8
 # v1 checkpoints predate the tfail/rc_shi/rc_slo SimState fields; all three
 # are derivable from active/failed/rc_src plus the cluster stake table, so
 # v1 files remain loadable when ``tables`` is passed to restore_sim_state.
@@ -54,10 +54,16 @@ _FORMAT_VERSION = 7
 # Pre-v7 files were written by engines whose direction bit was
 # identically False and whose rescue/qdrop counters never existed, so all
 # four arrays backfill as zeros (exact) and the adaptive block as the
-# engine defaults.  The committed v1-v6 fixtures in
+# engine defaults.  v8 adds the node-health observatory (obs/health.py):
+# the SimState ``health_prune_recv``/``health_first_round`` planes and the
+# TrafficState ``health_prune_recv``/``health_lat_acc``/``health_del_acc``/
+# ``health_rescued_acc`` planes, plus a ``health`` meta block (the gate and
+# digest top-k).  Pre-v8 files were written by engines with no health gate,
+# so every plane backfills as zeros — exact, because the gated-off engine
+# carries the planes as identical zeros.  The committed v1-v7 fixtures in
 # tests/fixtures/checkpoints pin that forward-compat contract forever
 # (tests/test_checkpoint.py).
-_READABLE_VERSIONS = (1, 2, 3, 4, 5, 6, 7)
+_READABLE_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
 
 # EngineParams fields that define array shapes; a mismatch makes the stored
 # state unusable under the new compile geometry.
@@ -101,6 +107,13 @@ _ADAPTIVE_FIELDS = ("adaptive_switch_threshold", "adaptive_switch_hysteresis")
 _ADAPTIVE_DEFAULTS = {f: EngineParams._field_defaults[f]
                       for f in _ADAPTIVE_FIELDS}
 
+# EngineParams fields describing the node-health observatory (v8 meta
+# block); the gate is static, so the recorded value documents what the
+# planes in the file actually accumulated (False -> all-zero planes).
+_HEALTH_FIELDS = ("health",)
+_HEALTH_DEFAULTS = {f: EngineParams._field_defaults[f]
+                    for f in _HEALTH_FIELDS}
+
 
 def save_state(path: str, state, params, config=None,
                iteration: int = 0, resilience: dict | None = None,
@@ -129,6 +142,9 @@ def save_state(path: str, state, params, config=None,
         # v7: the adaptive push-pull switch knobs (adaptive.py)
         "adaptive": {f: pdict.get(f, _ADAPTIVE_DEFAULTS[f])
                      for f in _ADAPTIVE_FIELDS},
+        # v8: the node-health observatory gate (obs/health.py)
+        "health": {f: pdict.get(f, _HEALTH_DEFAULTS[f])
+                   for f in _HEALTH_FIELDS},
         "iteration": int(iteration),
         # v5: journal cross-reference (resilience.py) — {} for plain
         # single-run checkpoints with no journal alongside
@@ -187,6 +203,7 @@ def load_state(path: str, params=None, expect_kind=None):
     meta.setdefault("resilience", {})
     meta.setdefault("traffic", dict(_TRAFFIC_DEFAULTS))
     meta.setdefault("adaptive", dict(_ADAPTIVE_DEFAULTS))
+    meta.setdefault("health", dict(_HEALTH_DEFAULTS))
     meta.setdefault("kind", "sim")
     if expect_kind is not None and meta["kind"] != expect_kind:
         hint = ("restore_traffic_state / the --traffic-values run path"
@@ -235,6 +252,15 @@ def load_state(path: str, params=None, expect_kind=None):
                     "diverges from the original run",
                     f, getattr(params, f, _ADAPTIVE_DEFAULTS[f]),
                     meta["adaptive"][f])
+        for f in _HEALTH_FIELDS:
+            if (getattr(params, f, _HEALTH_DEFAULTS[f])
+                    != meta["health"][f]):
+                log.warning(
+                    "WARNING: resuming with %s=%s but checkpoint was written "
+                    "with %s — the health planes cover only the rounds run "
+                    "under an enabled gate",
+                    f, getattr(params, f, _HEALTH_DEFAULTS[f]),
+                    meta["health"][f])
     return arrays, stored, meta
 
 
@@ -267,6 +293,14 @@ def restore_sim_state(path: str, params=None, tables=None):
         # identically False (no adaptive mode existed) — zeros are exact
         arrays["adaptive_pull_on"] = np.zeros(
             (arrays["failed"].shape[0],), bool)
+        missing = set(SimState._fields) - set(arrays)
+    health_fields = {"health_prune_recv", "health_first_round"}
+    if missing & health_fields:
+        # pre-v8 files predate the node-health observatory; the gated-off
+        # engine carries these planes as identical zeros, so zeros are exact
+        o, n = arrays["failed"].shape
+        for f in missing & health_fields:
+            arrays[f] = np.zeros((o, n), np.int32)
         missing = set(SimState._fields) - set(arrays)
     derivable = {"tfail", "rc_shi", "rc_slo"}
     if missing and missing <= derivable and tables is not None:
@@ -325,6 +359,15 @@ def restore_traffic_state(path: str, params=None):
             arrays["v_rescued"] = np.zeros((v,), np.int32)
         if "v_qdrop" in missing:
             arrays["v_qdrop"] = np.zeros((v,), np.int32)
+        missing = set(TrafficState._fields) - set(arrays)
+    health_fields = {"health_prune_recv", "health_lat_acc",
+                     "health_del_acc", "health_rescued_acc"}
+    if missing & health_fields:
+        # pre-v8 traffic checkpoints predate the node-health observatory;
+        # the gated-off engine carries the planes as identical zeros
+        n = arrays["failed"].shape[0]
+        for f in missing & health_fields:
+            arrays[f] = np.zeros((n,), np.int32)
         missing = set(TrafficState._fields) - set(arrays)
     if missing:
         raise ValueError(f"traffic checkpoint missing fields: "

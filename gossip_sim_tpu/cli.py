@@ -211,6 +211,7 @@ def _engine_params(config, num_nodes: int):
         fail_fraction=(config.fraction_to_fail
                        if config.test_type == Testing.FAIL_NODES else 0.0),
         trace_prune_cap=config.trace_prune_cap,
+        health=config.health,
         **_impair_params(config),
         **_pull_params(config),
         **_traffic_params(config),
@@ -475,6 +476,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "Costs one extra XLA compile per distinct "
                         "executable (pair with --compilation-cache-dir "
                         "to make it a disk hit); zero bit-impact")
+    p.add_argument("--health", action="store_true",
+                   help="node-health observatory (obs/health.py): "
+                        "accumulate per-node load/latency/drop planes "
+                        "inside the jitted round (egress/ingress, queue "
+                        "drops by side, prunes issued AND received, "
+                        "first-delivery rounds, pull rescues) and digest "
+                        "them on device per measured block — stake-decile "
+                        "segment sums + top-k hot nodes, so the host only "
+                        "harvests [10,·]/[k,·] arrays. Feeds the REQUIRED "
+                        "node_health run-report section, the "
+                        "sim_node_health Influx series, and "
+                        "tools/health_report.py. Off = bit-identical "
+                        "output to a build without the gate")
+    p.add_argument("--health-topk", type=int, default=10,
+                   help="hot nodes extracted per health digest metric "
+                        "(the [k,·] harvest; --health only)")
     p.add_argument("--trace-dir", default="", metavar="DIR",
                    help="flight recorder (obs/trace.py): capture per-round "
                         "protocol events (delivery edges + outcomes, first-"
@@ -574,6 +591,8 @@ def config_from_args(args) -> Config:
         raise SystemExit("sweep-lanes must be >= 0")
     if args.memwatch_interval_s < 0:
         raise SystemExit("memwatch-interval-s must be >= 0")
+    if args.health_topk < 1:
+        raise SystemExit("health-topk must be >= 1")
     return Config(
         gossip_push_fanout=args.push_fanout,
         gossip_active_set_size=args.active_set_size,
@@ -631,6 +650,8 @@ def config_from_args(args) -> Config:
         run_report_path=args.run_report_path,
         memwatch_interval_s=args.memwatch_interval_s,
         capacity_harvest=args.capacity_harvest,
+        health=args.health,
+        health_topk=args.health_topk,
         trace_dir=args.trace_dir,
         trace_origins=args.trace_origins,
         trace_prune_cap=args.trace_prune_cap,
@@ -705,6 +726,11 @@ def _run_oracle_backend(config: Config, accounts, origin_pubkey, stats,
         log.warning("WARNING: --checkpoint-path is supported by the tpu "
                     "backend only; the oracle backend will not write %s",
                     config.checkpoint_path)
+    if config.health:
+        log.warning("WARNING: --health digests come from the engine's "
+                    "on-device planes (tpu backend) or the traffic "
+                    "oracle; the single-origin oracle backend leaves the "
+                    "node_health report section disabled")
     reg = get_registry()
     reg.set_info("platform", "oracle")
     rng = ChaChaRng.from_seed_byte(config.seed % 256)
@@ -1049,6 +1075,8 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
             hb.beat(done)
             _push_sim_perf_point(dp_queue, sim_iter, start_ts, blk_wall,
                                  n_it, 1)
+            _emit_node_health(config, tables, state, dp_queue, sim_iter,
+                              start_ts, warm + done, traffic=False)
             _save_checkpoint(warm + done, force=False)
             if resilience.shutdown_requested():
                 # finish-the-harvest contract: this block's stats are fed
@@ -1071,6 +1099,8 @@ def _run_tpu_backend(config: Config, accounts, origin_pubkey, stats,
         log.info("jax.profiler trace written to %s", config.jax_profile_dir)
 
     _feed_message_counters(stats, state, 0, index)
+    _emit_node_health(config, tables, state, None, sim_iter, start_ts,
+                      config.gossip_iterations, traffic=False, final=True)
     if params.has_churn:
         # mirror the oracle backend: report the final churn-failed set
         _record_failed()
@@ -1764,6 +1794,13 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
     single_batch = total_o <= batch
 
     agg = AllOriginsStats(index, params.hist_bins)
+    # node-health accumulation: per-batch SimState planes sum into one
+    # [P, N] i64 stack (journal-sidecar-carried, so a resumed run keeps
+    # the committed batches' counts)
+    health_stack_acc = (np.zeros((len(SIM_HEALTH_METRICS), N), np.int64)
+                        if config.health else None)
+    health_decile_ids = (np.asarray(tables.stake_decile)
+                         if config.health else None)
     hb = Heartbeat(total_o, label="all-origins", unit="origin")
     # the registry counter is process-cumulative; the summary reports this
     # run's delta so library callers invoking run_all_origins repeatedly
@@ -1799,6 +1836,9 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
                 f"reconciled. Remove {journal.path} and {sidecar} to "
                 f"start fresh.")
         padded_restored = int(sd.pop("padded_sims", 0))
+        restored_health = sd.pop("node_health_stack", None)
+        if health_stack_acc is not None and restored_health is not None:
+            health_stack_acc += np.asarray(restored_health, np.int64)
         agg.load_state_dict(sd)
         for b in range(first_unit):
             replay_influx_lines(dp_queue,
@@ -1906,6 +1946,21 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
                           heal_at=config.heal_at,
                           impaired=config.impairments_on,
                           pull=config.has_pull)
+            if health_stack_acc is not None:
+                bstack = _sim_health_stack_np(state_np)
+                # in-place: the accumulator is a closed-over name
+                np.add(health_stack_acc, bstack, out=health_stack_acc)
+                try:
+                    from .obs import health
+                    dig = health.digest_stack_np(bstack, health_decile_ids,
+                                                 config.health_topk)
+                    _publish_node_health(
+                        config, SIM_HEALTH_METRICS, dig, health_decile_ids,
+                        feed, 0, start_ts, lo // batch,
+                        source="all-origins", final=False)
+                except Exception as e:  # pragma: no cover - telemetry only
+                    log.warning("WARNING: node-health digest not emitted "
+                                "(%s)", e)
         _push_sim_perf_point(feed, 0, start_ts, blk_wall,
                              config.gossip_iterations, n_valid)
         log.info("all-origins: %s/%s origins done",
@@ -1915,6 +1970,8 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
             sd["padded_sims"] = padded_restored + int(
                 reg.counter("padded_sims") - padded_before)
             sd["committed_units"] = lo // batch + 1
+            if health_stack_acc is not None:
+                sd["node_health_stack"] = health_stack_acc
             _save_agg_sidecar(sidecar, sd)
             journal.commit(lo // batch, {"lo": int(lo), "batch": int(batch),
                                          "lines": _take_unit_lines(feed)})
@@ -1984,6 +2041,17 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
             "stats": agg,
         }
     agg.finalize(config)
+    if health_stack_acc is not None:
+        try:
+            from .obs import health
+            dig = health.digest_stack_np(health_stack_acc,
+                                         health_decile_ids,
+                                         config.health_topk)
+            _publish_node_health(config, SIM_HEALTH_METRICS, dig,
+                                 health_decile_ids, None, 0, start_ts,
+                                 total_o, source="all-origins", final=True)
+        except Exception as e:  # pragma: no cover - telemetry-only path
+            log.warning("WARNING: node-health digest not emitted (%s)", e)
     _warn_shape_truncation(
         {"inb_dropped": agg.inb_dropped, "rc_overflow": agg.rc_overflow,
          "hop_clamped": agg.hop_clamped},
@@ -2022,9 +2090,13 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
         })
     # queue-cap drops ride next to the hop-clamp count in every summary
     # line (traffic runs report real counts via run_traffic; keeping the
-    # key here too means a capped run can never be mistaken for a lossless
-    # one by a dashboard reading either summary shape)
+    # keys here too means a capped run can never be mistaken for a
+    # lossless one by a dashboard reading either summary shape), split by
+    # queue side like the traffic summary: ingress = receiver-cap drops,
+    # egress = sender-cap deferrals
     summary["queue_dropped"] = 0
+    summary["queue_dropped_ingress"] = 0
+    summary["queue_deferred_egress"] = 0
     log.info("ALL-ORIGINS SUMMARY: %s",
              {k: v for k, v in summary.items() if k != "stats"})
     return summary
@@ -2415,6 +2487,118 @@ def _push_sim_capacity_point(dp_queue, start_ts: str) -> None:
         log.warning("WARNING: sim_capacity point not emitted (%s)", e)
 
 
+#: node-health digest metric rows, in stack order (obs/health.py).  The
+#: single-origin planes live on SimState, the traffic planes on
+#: TrafficState; "deferred" is the egress-side queue drop, "queue_dropped"
+#: the ingress side — the two sides the summary line reports separately.
+SIM_HEALTH_METRICS = ("egress", "ingress", "prunes_sent", "prunes_recv",
+                      "rescued", "stranded", "first_round_sum", "delivered")
+TRAFFIC_HEALTH_METRICS = ("sent", "recv", "deferred", "queue_dropped",
+                          "prunes_sent", "prunes_recv", "rescued",
+                          "lat_sum", "delivered")
+
+
+def _health_stack(state, *, traffic: bool):
+    """[P, N] i32 device stack of the run's health metric planes, row
+    order matching SIM_/TRAFFIC_HEALTH_METRICS.  SimState planes are
+    [O, N] — the origin axis sums on device, so the host never transfers
+    an O(N)-per-origin array."""
+    import jax.numpy as jnp
+    if traffic:
+        return jnp.stack([
+            state.sent_acc, state.recv_acc, state.defer_acc,
+            state.qdrop_acc, state.prune_acc, state.health_prune_recv,
+            state.health_rescued_acc, state.health_lat_acc,
+            state.health_del_acc])
+    fr = state.health_first_round      # round+1 encoding, 0 = unreached
+    rows = [state.egress_acc, state.ingress_acc, state.prune_acc,
+            state.health_prune_recv, state.pull_rescued_acc,
+            state.stranded_acc, jnp.maximum(fr - 1, 0),
+            (fr > 0).astype(jnp.int32)]
+    return jnp.stack([jnp.sum(r, axis=0, dtype=jnp.int32) for r in rows])
+
+
+def _sim_health_stack_np(state) -> np.ndarray:
+    """Host twin of ``_health_stack(traffic=False)`` over an already-
+    materialized (numpy) SimState batch -> [P, N] i64 (the all-origins
+    path sums these per-batch stacks across the whole origin axis)."""
+    fr = np.asarray(state.health_first_round, np.int64)
+    rows = [np.asarray(state.egress_acc, np.int64),
+            np.asarray(state.ingress_acc, np.int64),
+            np.asarray(state.prune_acc, np.int64),
+            np.asarray(state.health_prune_recv, np.int64),
+            np.asarray(state.pull_rescued_acc, np.int64),
+            np.asarray(state.stranded_acc, np.int64),
+            np.maximum(fr - 1, 0), (fr > 0).astype(np.int64)]
+    return np.stack([r.sum(axis=0) for r in rows])
+
+
+def _health_latency_table(names, dig, decile_sizes):
+    """Decile coverage-latency table: per-decile mean first-delivery
+    latency (traffic: lat_sum/delivered; sim: first_round_sum/delivered)
+    plus node counts, so the low-stake deciles' first-delivery gap is
+    directly readable from the report."""
+    i_lat = names.index("lat_sum" if "lat_sum" in names
+                        else "first_round_sum")
+    i_del = names.index("delivered")
+    lat = dig["deciles"][i_lat]
+    delivered = dig["deciles"][i_del]
+    return {
+        "decile_nodes": [int(x) for x in decile_sizes],
+        "lat_sum_deciles": [int(x) for x in lat],
+        "delivered_deciles": [int(x) for x in delivered],
+        "mean_latency_deciles": [
+            round(float(s) / float(d), 4) if d else 0.0
+            for s, d in zip(lat, delivered)],
+    }
+
+
+def _emit_node_health(config, tables, state, dp_queue, sim_iter, start_ts,
+                      block: int, *, traffic: bool, final: bool = False):
+    """Per-block node-health digest (obs/health.py): ONE extra device
+    dispatch whose host harvest is [10,·]/[k,·] arrays, emitted as a
+    ``sim_node_health`` point; ``final`` additionally stamps the
+    run-report ``node_health`` section into registry info.  A telemetry
+    failure must never kill a run."""
+    if not config.health:
+        return
+    try:
+        from .obs import health
+        names = TRAFFIC_HEALTH_METRICS if traffic else SIM_HEALTH_METRICS
+        dig = health.digest_stack(_health_stack(state, traffic=traffic),
+                                  tables.stake_decile,
+                                  config.health_topk)
+        _publish_node_health(config, names, dig,
+                             np.asarray(tables.stake_decile), dp_queue,
+                             sim_iter, start_ts, block,
+                             source="traffic" if traffic else "sim",
+                             final=final)
+    except Exception as e:  # pragma: no cover - telemetry-only path
+        log.warning("WARNING: node-health digest not emitted (%s)", e)
+
+
+def _publish_node_health(config, names, dig, decile_ids, dp_queue, sim_iter,
+                         start_ts, block, *, source, final):
+    """Shared back half of the health emitters: the per-block
+    ``sim_node_health`` point and (on ``final``) the run-report section
+    stamp.  ``dig`` comes from digest_stack (engine) or digest_stack_np
+    (oracle) — bit-identical by construction."""
+    from .obs import health
+    k = config.health_topk
+    if dp_queue is not None:
+        dp = InfluxDataPoint(start_ts, sim_iter)
+        dp.create_sim_node_health_point(
+            block, health.influx_values(names, dig, topk=k))
+        dp_queue.push_back(dp)
+    if final:
+        sizes = np.bincount(np.asarray(decile_ids),
+                            minlength=health.NUM_DECILES)
+        section = health.build_node_health_section(
+            names, dig, enabled=True, topk=k, source=source,
+            latency=_health_latency_table(names, dig, sizes))
+        get_registry().set_info("node_health", section)
+
+
 def _drain_influx(dp_queue, influx_thread, start_ts: str = "0",
                   emit_capacity: bool = False):
     """Push the end sentinel, drain the reporter thread, and surface the
@@ -2668,6 +2852,15 @@ def _run_traffic_oracle_point(config, params, stakes_np, stats, dp_queue,
     adaptive = config.gossip_mode == "adaptive"
     if adaptive:
         from .stats.traffic import ADAPTIVE_ROUND_FIELDS
+    health_acc = None
+    if config.health:
+        # oracle twin of the engine's TrafficState health planes: the
+        # warm-gated host-side sum of run_round's per-node rows, digested
+        # through the SAME integer math (digest_stack_np) at end of run
+        from .obs.health import stake_decile_ids
+        health_decile_ids = stake_decile_ids(stakes_np)
+        health_acc = np.zeros((len(TRAFFIC_HEALTH_METRICS), len(stakes_np)),
+                              np.int64)
     hb = Heartbeat(config.gossip_iterations, label="traffic rounds",
                    unit="iter")
     for it in range(config.gossip_iterations):
@@ -2700,6 +2893,12 @@ def _run_traffic_oracle_point(config, params, stakes_np, stats, dp_queue,
             totals["recv"] += (tr.accepted + tr.pull_served
                                + tr.pull_responses)
             totals["prunes"] += tr.prunes_sent
+            if health_acc is not None:
+                health_acc += np.stack([
+                    tr.node_sent, tr.node_recv, tr.node_deferred,
+                    tr.node_queue_dropped, tr.node_prune_sent,
+                    tr.node_prune_recv, tr.node_rescued, tr.node_lat_sum,
+                    tr.node_delivered])
             _push_sim_traffic_point(config, dp_queue, sim_iter, start_ts,
                                     it, vals)
             if adaptive:
@@ -2708,6 +2907,17 @@ def _run_traffic_oracle_point(config, params, stakes_np, stats, dp_queue,
                     {k: vals[k] for k in ADAPTIVE_ROUND_FIELDS})
         if it % 10 == 0:
             hb.beat(it)
+    if health_acc is not None:
+        try:
+            from .obs import health
+            dig = health.digest_stack_np(health_acc, health_decile_ids,
+                                         config.health_topk)
+            _publish_node_health(config, TRAFFIC_HEALTH_METRICS, dig,
+                                 health_decile_ids, dp_queue, sim_iter,
+                                 start_ts, config.gossip_iterations,
+                                 source="oracle-traffic", final=True)
+        except Exception as e:  # pragma: no cover - telemetry-only path
+            log.warning("WARNING: node-health digest not emitted (%s)", e)
     live = sum(sl is not None for sl in oracle.slots)
     stats.feed_final(dict(live_at_end=live, **totals))
 
@@ -2837,6 +3047,8 @@ def _run_traffic_tpu_point(config, params, stakes_np, index, stats,
         done += n_it
         hb.beat(done)
         _push_sim_perf_point(dp_queue, sim_iter, start_ts, blk_wall, n_it, 1)
+        _emit_node_health(config, tables, state, dp_queue, sim_iter,
+                          start_ts, warm + done, traffic=True)
         _save_checkpoint(warm + done, force=False)
         if resilience.shutdown_requested():
             stats.feed_final(_traffic_final_from_state(state))
@@ -2852,6 +3064,8 @@ def _run_traffic_tpu_point(config, params, stakes_np, index, stats,
     if tracer is not None:
         tracer.finalize()
         log.info("traffic trace written to %s", config.trace_dir)
+    _emit_node_health(config, tables, state, None, sim_iter, start_ts,
+                      config.gossip_iterations, traffic=True, final=True)
     stats.feed_final(_traffic_final_from_state(state))
     _save_checkpoint(config.gossip_iterations)
 
@@ -2859,21 +3073,27 @@ def _run_traffic_tpu_point(config, params, stakes_np, index, stats,
 def _log_traffic_summary(label, s):
     """The traffic run summary line: per-value outcomes + queue-cap drops
     surfaced alongside the hop-clamp count (a capped run must never read
-    as lossless)."""
+    as lossless), with the queue-drop SIDE spelled out — egress-cap
+    deferrals at the sender vs ingress-cap drops at the receiver are
+    different bottlenecks, and lumping them misdirects capacity tuning."""
+    qd_in = s.get("queue_dropped_ingress", s["queue_dropped"])
+    qd_eg = s.get("queue_deferred_egress", s["queue_deferred"])
     log.info(
         "TRAFFIC SUMMARY%s: %s values injected (%s dropped at injection), "
         "%s retired (%s converged [%s by pull rescue], %s stranded "
         "[%s starved by queue drops], %s unfinished) | "
         "coverage mean %.4f | latency mean %.2f p90 %.2f rounds | "
-        "value RMR mean %.3f | queue: %s deferred (max depth %s), "
-        "%s dropped | loss %s, hop_clamped %s",
+        "value RMR mean %.3f | queue: %s deferred egress-side (max depth "
+        "%s), %s dropped ingress-side (push %s + pull %s) | loss %s, "
+        "hop_clamped %s",
         label, s["values_injected"], s["inject_dropped"],
         s["values_retired"], s["values_converged"], s["values_rescued"],
         s["values_stranded"], s["values_starved_queue_drop"],
         s["values_unfinished"], s["value_coverage_mean"],
         s["value_latency_mean"], s["value_latency_p90"],
-        s["value_rmr_mean"], s["queue_deferred"], s["qdepth_max"],
-        s["queue_dropped"], s["loss_dropped"], s["hop_clamped"])
+        s["value_rmr_mean"], qd_eg, s["qdepth_max"],
+        qd_in, s["queue_dropped"], qd_in - s["queue_dropped"],
+        s["loss_dropped"], s["hop_clamped"])
     if "adaptive_pull_sent" in s:
         log.info(
             "ADAPTIVE SUMMARY%s: %s values switched to pull | rescue "

@@ -211,6 +211,14 @@ class Config:
                                     # round); 0 = auto (16*num_nodes).
                                     # Raise when the trace manifest flags
                                     # truncated_prune_rounds
+    health: bool = False            # node-health observatory (obs/health.py):
+                                    # accumulate per-node load/latency/drop
+                                    # planes inside the jitted round and
+                                    # digest them per measured block (decile
+                                    # segment sums + hot-node top-k).  Off =
+                                    # every output bit-identical to today
+    health_topk: int = 10           # hot nodes extracted per digest (the
+                                    # [k,·] harvest; report + sim_node_health)
     compilation_cache_dir: str = ""  # persistent XLA compilation cache
                                     # (engine/cache.py): compiled
                                     # executables are reused across

@@ -114,6 +114,9 @@ class ClusterTables(NamedTuple):
     slo: jax.Array       # [N + 1] i32 stake & 0x7fffffff
     side: jax.Array      # [N + 1] i32 stake-bipartition side (faults.py);
                          # index N is a 0 pad — only read under partition_at
+    stake_decile: jax.Array  # [N] i32 stake-rank decile id, 0 (lowest
+                             # stake) .. 9 (highest); segment ids for the
+                             # on-device health digests (obs/health.py)
 
 
 class SimState(NamedTuple):
@@ -139,6 +142,15 @@ class SimState(NamedTuple):
                                    # (the pull-tagged slice of hops_hist_acc)
     pull_rescued_acc: jax.Array    # [O, N] i32 measured rounds each node was
                                    # rescued by a pull response (pull.py)
+    health_prune_recv: jax.Array   # [O, N] i32 measured-round prune messages
+                                   # *received* per node (the prunee-side twin
+                                   # of prune_acc); zeros unless static.health
+    health_first_round: jax.Array  # [O, N] i32 first round the origin's value
+                                   # reached each node, encoded round+1 with
+                                   # 0 = never reached; deliberately NOT
+                                   # warm-up gated (a first delivery during
+                                   # warm-up is still the first delivery);
+                                   # zeros unless static.health
     adaptive_pull_on: jax.Array    # [O] bool direction bit (adaptive.py):
                                    # the pull phase runs this round iff set;
                                    # re-decided each round from push coverage
@@ -157,6 +169,14 @@ def make_cluster_tables(stakes_lamports: np.ndarray) -> ClusterTables:
     buckets = stake_buckets_array(stakes.astype(np.uint64)).astype(np.int32)
     padded = np.concatenate([stakes, [0]])
     side = np.concatenate([stake_bipartition(stakes).astype(np.int32), [0]])
+    # Stake-rank deciles: stable ascending sort so equal stakes tie-break by
+    # node id, decile 0 = lowest-staked tenth.  Host-side numpy (like every
+    # other table here) so the engine and the loop oracles share one id map.
+    n = stakes.shape[0]
+    order = np.argsort(stakes, kind="stable")
+    rank = np.empty(n, dtype=np.int64)
+    rank[order] = np.arange(n, dtype=np.int64)
+    stake_decile = (rank * 10 // n).astype(np.int32)
     return ClusterTables(
         stakes=jnp.asarray(padded),
         buckets=jnp.asarray(buckets),
@@ -164,6 +184,7 @@ def make_cluster_tables(stakes_lamports: np.ndarray) -> ClusterTables:
         shi=jnp.asarray((padded >> 31).astype(np.int32)),
         slo=jnp.asarray((padded & 0x7FFFFFFF).astype(np.int32)),
         side=jnp.asarray(side),
+        stake_decile=jnp.asarray(stake_decile),
     )
 
 
@@ -339,6 +360,8 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
         hops_hist_acc=zi((O, H)),
         pull_hops_hist_acc=zi((O, H)),
         pull_rescued_acc=zi((O, N)),
+        health_prune_recv=zi((O, N)),
+        health_first_round=zi((O, N)),
         adaptive_pull_on=jnp.zeros((O,), bool),
     )
 
@@ -1124,6 +1147,35 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
                 kn.adaptive_switch_hysteresis, jnp)
         else:
             new_adapt = state.adaptive_pull_on
+        if p.health:
+            # node-health observatory (obs/health.py): prunee-side prune
+            # counts via one deterministic integer segment-sum over the
+            # sparse (pruner -> prunee) slots.  Prune rounds are bursty
+            # (they batch at the upsert threshold), so zero-prune rounds
+            # skip the scatter behind the same lax.cond the trace uses.
+            def _prune_recv():
+                seg = jnp.where(pruned_slot, src_sorted, N)
+                seg = seg + (jnp.arange(O, dtype=jnp.int32)
+                             * (N + 1))[:, None, None]
+                return jax.ops.segment_sum(
+                    pruned_slot.astype(jnp.int32).reshape(-1),
+                    seg.reshape(-1),
+                    num_segments=O * (N + 1)).reshape(O, N + 1)[:, :N]
+
+            prune_recv_round = lax.cond(
+                m_prunes.sum() > 0, _prune_recv,
+                lambda: jnp.zeros((O, N), jnp.int32))
+            new_health_prune_recv = (state.health_prune_recv
+                                     + g * prune_recv_round)
+            # first-delivery round, encoded round+1 (0 = never reached);
+            # not warm-up gated — the first delivery is the first delivery
+            # whenever it happens.
+            new_health_first = jnp.where(
+                (state.health_first_round == 0) & reached_all,
+                (it + 1).astype(jnp.int32), state.health_first_round)
+        else:
+            new_health_prune_recv = state.health_prune_recv
+            new_health_first = state.health_first_round
         new_state = SimState(
             key=state.key,
             active=new_active,
@@ -1142,6 +1194,8 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             hops_hist_acc=state.hops_hist_acc + g * hr,
             pull_hops_hist_acc=new_pull_hist,
             pull_rescued_acc=new_pull_rescued,
+            health_prune_recv=new_health_prune_recv,
+            health_first_round=new_health_first,
             adaptive_pull_on=new_adapt,
         )
         rows = {

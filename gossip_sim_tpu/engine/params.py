@@ -151,6 +151,14 @@ class EngineStatic(NamedTuple):
     # value axis).  0 = the traffic subsystem is OFF and no traffic code
     # exists in any compiled graph — the M=1/caps-off bit-identity gate.
     traffic_slots: int = 0
+    # Node-health observatory gate (obs/health.py): True compiles the
+    # per-node health-plane accumulation (prune-received counts,
+    # first-delivery rounds/latencies) into the round.  False (default)
+    # leaves the health planes untouched zeros and the compiled graph
+    # free of any health code — the same bit-identity contract as the
+    # trace/traffic gates (parity snapshots and deterministic Influx
+    # wire lines are byte-identical with the gate off).
+    health: bool = False
 
     @property
     def num_buckets(self) -> int:
@@ -337,6 +345,12 @@ class EngineParams(NamedTuple):
                               # once).  Overflow is counted, never silently
                               # dropped — only the trace truncates, the
                               # simulation itself is unaffected.
+    health: bool = False    # node-health observatory (obs/health.py):
+                            # accumulate the per-node health planes
+                            # (prune-received, first-delivery) inside the
+                            # jitted round scan.  Static gate — off, the
+                            # compiled round carries zero health code and
+                            # every output is bit-identical to today.
 
     @property
     def num_buckets(self) -> int:
@@ -415,6 +429,7 @@ class EngineParams(NamedTuple):
             gossip_mode=self.gossip_mode,
             pull_slots=self.pull_slots_resolved if self.has_pull else 0,
             traffic_slots=self.traffic_values if self.has_traffic else 0,
+            health=self.health,
         )
 
     def knob_values(self) -> EngineKnobs:

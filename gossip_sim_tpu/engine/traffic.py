@@ -110,6 +110,18 @@ class TrafficState(NamedTuple):
     v_pull: jax.Array      # [V] bool value is in its pull-rescue phase
     v_rescued: jax.Array   # [V] i32 nodes delivered via pull rescue
     v_qdrop: jax.Array     # [V] i32 ingress queue drops that hit the value
+    # node-health observatory planes (obs/health.py; zeros unless
+    # EngineStatic.health — the updates are compiled out with the gate off)
+    health_prune_recv: jax.Array   # [N] i32 prune messages *received* per
+                                   # node (prunee side; prune_acc is the
+                                   # pruner side)
+    health_lat_acc: jax.Array      # [N] i32 Σ first-delivery latencies
+                                   # (it - v_birth + 1) over this node's
+                                   # first deliveries, pull rescues included
+    health_del_acc: jax.Array      # [N] i32 first-delivery count per node
+                                   # (the divisor for health_lat_acc)
+    health_rescued_acc: jax.Array  # [N] i32 first deliveries that arrived
+                                   # via a pull rescue (subset of del_acc)
 
 
 def device_traffic_tables(stakes) -> TrafficTables:
@@ -154,6 +166,10 @@ def init_traffic_state(stakes, params, seed: int) -> TrafficState:
         sent_acc=zi((N,)), recv_acc=zi((N,)), prune_acc=zi((N,)),
         v_pull=jnp.zeros((V,), bool),
         v_rescued=zi((V,)), v_qdrop=zi((V,)),
+        health_prune_recv=zi((N,)),
+        health_lat_acc=zi((N,)),
+        health_del_acc=zi((N,)),
+        health_rescued_acc=zi((N,)),
     )
 
 
@@ -838,6 +854,44 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
             recv_node_all = accepted_node.astype(jnp.int32)
             qdrop_node_all = qdrop_node.astype(jnp.int32)
             inflow_node = accepted_node.astype(jnp.int32)
+        if p.health:
+            # node-health observatory planes (obs/health.py): first
+            # deliveries (push + pull rescues, disjoint by construction —
+            # rescues only reach non-holders) feed per-node latency
+            # sums/counts against the value's injection round; prunee-side
+            # prune counts come from one deterministic integer segment-sum
+            # over the sparse (pruner -> prunee) slots, skipped entirely on
+            # zero-prune rounds behind the same lax.cond the trace uses.
+            del_nv = new_del.astype(jnp.int32)                   # [V, N]
+            resc_nv = (pull_del.astype(jnp.int32)
+                       if pull_del is not None
+                       else jnp.zeros((V, N), jnp.int32))
+            del_all = del_nv + resc_nv
+            lat_v = it - v_birth + 1                             # [V]
+            lat_node = jnp.sum(del_all * lat_v[:, None], axis=0,
+                               dtype=jnp.int32)
+            del_node = jnp.sum(del_all, axis=0, dtype=jnp.int32)
+            resc_node = jnp.sum(resc_nv, axis=0, dtype=jnp.int32)
+
+            def _prune_recv():
+                seg = jnp.where(pruned_slot, src_sorted, N).reshape(-1)
+                return jax.ops.segment_sum(
+                    pruned_slot.astype(jnp.int32).reshape(-1), seg,
+                    num_segments=N + 1)[:N]
+
+            prune_recv_node = lax.cond(
+                jnp.sum(m_prunes) > 0, _prune_recv,
+                lambda: jnp.zeros((N,), jnp.int32))
+            new_health_prune_recv = (state.health_prune_recv
+                                     + g * prune_recv_node)
+            new_health_lat = state.health_lat_acc + g * lat_node
+            new_health_del = state.health_del_acc + g * del_node
+            new_health_resc = state.health_rescued_acc + g * resc_node
+        else:
+            new_health_prune_recv = state.health_prune_recv
+            new_health_lat = state.health_lat_acc
+            new_health_del = state.health_del_acc
+            new_health_resc = state.health_rescued_acc
         new_state = TrafficState(
             active=new_active, failed=failed, next_vid=next_vid,
             v_live=v_live_post, v_vid=v_vid, v_origin=v_origin,
@@ -856,6 +910,10 @@ def traffic_round_step(params, tables: ClusterTables, ttables: TrafficTables,
             prune_acc=state.prune_acc
             + g * jnp.sum(n_pruned, axis=0, dtype=jnp.int32),
             v_pull=new_v_pull, v_rescued=v_rescued, v_qdrop=v_qdrop,
+            health_prune_recv=new_health_prune_recv,
+            health_lat_acc=new_health_lat,
+            health_del_acc=new_health_del,
+            health_rescued_acc=new_health_resc,
         )
         rows = {
             "injected": n_inj,

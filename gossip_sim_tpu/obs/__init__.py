@@ -9,6 +9,8 @@ from .capacity import (CAPACITY_SCHEMA, capacity_ledger, fit_budget,
                        harvest_summary, parse_size,
                        predict_sim_state_bytes,
                        predict_traffic_state_bytes, set_harvest_enabled)
+from .health import (HEALTH_SCHEMA, build_node_health_section, digest_stack,
+                     digest_stack_np, stake_decile_ids)
 from .heartbeat import Heartbeat
 from .report import (PER_CHIP_TARGET, RUN_REPORT_SCHEMA, bench_summary,
                      build_run_report, environment_info, validate_run_report,
@@ -27,4 +29,6 @@ __all__ = [
     "CAPACITY_SCHEMA", "capacity_ledger", "fit_budget", "harvest_summary",
     "parse_size", "predict_sim_state_bytes", "predict_traffic_state_bytes",
     "set_harvest_enabled",
+    "HEALTH_SCHEMA", "build_node_health_section", "digest_stack",
+    "digest_stack_np", "stake_decile_ids",
 ]

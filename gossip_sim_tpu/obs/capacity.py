@@ -69,8 +69,8 @@ class LedgerEntry(NamedTuple):
 
     name: str       # pytree field (dotted path for nested containers)
     group: str      # subsystem: active-set | received-cache |
-                    # traffic-planes | stats | pull | adaptive | core |
-                    # tables | knobs | trace
+                    # traffic-planes | stats | pull | adaptive | health |
+                    # core | tables | knobs | trace
     shape: tuple    # concrete shape at this config
     dtype: str
     bytes: int      # exact: prod(shape) * itemsize
@@ -131,6 +131,8 @@ def sim_state_entries(params, origin_batch: int = 1,
         e("hops_hist_acc", "stats", (O, H), "int32", "O*H*4", od),
         e("pull_hops_hist_acc", "pull", (O, H), "int32", "O*H*4", od),
         e("pull_rescued_acc", "pull", (O, N), "int32", "O*N*4", 1 + od),
+        e("health_prune_recv", "health", (O, N), "int32", "O*N*4", 1 + od),
+        e("health_first_round", "health", (O, N), "int32", "O*N*4", 1 + od),
         e("adaptive_pull_on", "adaptive", (O,), "bool", "O*1", od),
     ]
 
@@ -176,6 +178,10 @@ def traffic_state_entries(params) -> list:
         e("v_pull", "adaptive", (V,), "bool", "M*1", 0),
         e("v_rescued", "adaptive", (V,), "int32", "M*4", 0),
         e("v_qdrop", "adaptive", (V,), "int32", "M*4", 0),
+        e("health_prune_recv", "health", (N,), "int32", "N*4", 1),
+        e("health_lat_acc", "health", (N,), "int32", "N*4", 1),
+        e("health_del_acc", "health", (N,), "int32", "N*4", 1),
+        e("health_rescued_acc", "health", (N,), "int32", "N*4", 1),
     ]
 
 
@@ -197,6 +203,8 @@ def cluster_tables_entries(params,
         e("slo", "tables", (N + 1,), "int32", "(N+1)*4", 1),
         # np.concatenate([...i32, [0]]) promotes: the live array is i64
         e("side", "tables", (N + 1,), "int64", "(N+1)*8", 1),
+        # node-health decile ids (obs/health.py digest segment ids)
+        e("stake_decile", "tables", (N,), "int32", "N*4", 1),
     ]
 
 

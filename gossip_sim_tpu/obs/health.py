@@ -1,0 +1,227 @@
+"""Node health observatory: per-node load, latency, and drop attribution.
+
+The engine accumulates O(N) health planes inside the jitted round scan
+(engine/core.py, engine/traffic.py — behind the static
+``EngineStatic.health`` gate), and this module turns those planes into
+small host-harvestable digests **on device**:
+
+* stake-decile segment sums over the precomputed ``ClusterTables.
+  stake_decile`` id table — the host only ever sees a ``[P, 10]`` array
+  (P = number of metrics), never the raw ``[N]`` planes;
+* top-k hot-node extraction per metric (``lax.top_k`` — ties break
+  toward the lower node id, matching the numpy twin's lexsort);
+* load-imbalance Gini as an exact integer numerator/denominator pair
+  (the i64 sums are order-independent, so the device and the numpy twin
+  agree bit-for-bit; the one float division happens on the host).
+
+Everything here has a loop/numpy twin (`digest_stack_np`) used by the
+oracle parity tests (tests/test_health.py) and by ``tools/
+health_report.py`` when re-deriving digests from raw report planes.
+
+Like the rest of :mod:`gossip_sim_tpu.obs`, importing this module stays
+JAX-free — the device path imports JAX lazily inside the jitted-builder
+so bench.py's parent process never touches it.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+HEALTH_SCHEMA = "gossip-sim-tpu/node-health/v1"
+
+#: number of stake-decile segments (ClusterTables.stake_decile ids)
+NUM_DECILES = 10
+
+#: default hot-node extraction width (Config.health_topk)
+DEFAULT_TOPK = 10
+
+__all__ = [
+    "HEALTH_SCHEMA", "NUM_DECILES", "DEFAULT_TOPK",
+    "stake_decile_ids", "digest_stack", "digest_stack_np",
+    "decile_sums_np", "topk_nodes_np", "gini_parts_np", "gini_value",
+    "build_node_health_section", "influx_values",
+]
+
+
+# --------------------------------------------------------------------------
+# the decile id table (numpy twin of engine/core.py make_cluster_tables)
+# --------------------------------------------------------------------------
+
+def stake_decile_ids(stakes) -> np.ndarray:
+    """[N] i32 stake-rank decile ids: stable ascending sort (equal stakes
+    tie-break by node id), decile 0 = the lowest-staked tenth.  This is
+    the exact computation ``make_cluster_tables`` bakes into
+    ``ClusterTables.stake_decile`` — one id map shared by the engine and
+    every loop oracle."""
+    stakes = np.asarray(stakes, dtype=np.int64)
+    n = stakes.shape[0]
+    order = np.argsort(stakes, kind="stable")
+    rank = np.empty(n, dtype=np.int64)
+    rank[order] = np.arange(n, dtype=np.int64)
+    return (rank * 10 // n).astype(np.int32)
+
+
+# --------------------------------------------------------------------------
+# numpy twins (exact integer math — the parity reference)
+# --------------------------------------------------------------------------
+
+def decile_sums_np(metric, decile_ids) -> np.ndarray:
+    """[10] i64 per-decile sums of one [N] metric plane."""
+    out = np.zeros(NUM_DECILES, dtype=np.int64)
+    np.add.at(out, np.asarray(decile_ids), np.asarray(metric, np.int64))
+    return out
+
+
+def topk_nodes_np(metric, k: int):
+    """Top-k hot nodes of one [N] plane -> (idx [k] i32, val [k] i64).
+    Ties break toward the lower node id (lax.top_k's documented order)."""
+    metric = np.asarray(metric, dtype=np.int64)
+    n = metric.shape[0]
+    k = min(int(k), n)
+    order = np.lexsort((np.arange(n), -metric))[:k]
+    return order.astype(np.int32), metric[order]
+
+
+def gini_parts_np(metric):
+    """Exact integer Gini parts of one [N] plane -> (num, den) i64 with
+    ``gini = num / den`` (0 when den == 0).  Formulation: sort ascending,
+    ``num = sum((2i - n - 1) * x_i)``, ``den = n * sum(x)`` — every term
+    is an exact i64, so summation order cannot matter and the device twin
+    matches bit-for-bit."""
+    xs = np.sort(np.asarray(metric, dtype=np.int64))
+    n = xs.shape[0]
+    w = 2 * np.arange(1, n + 1, dtype=np.int64) - n - 1
+    return int(np.sum(w * xs)), int(n * np.sum(xs))
+
+
+def gini_value(num: int, den: int) -> float:
+    """The one float division, shared by both paths."""
+    return float(num) / float(den) if den else 0.0
+
+
+def digest_stack_np(stack, decile_ids, k: int) -> dict:
+    """Loop/numpy twin of :func:`digest_stack` over a [P, N] i64-able
+    stack.  Returns the identical integer arrays."""
+    stack = np.asarray(stack, dtype=np.int64)
+    dec = np.stack([decile_sums_np(row, decile_ids) for row in stack])
+    idx, val = zip(*(topk_nodes_np(row, k) for row in stack))
+    gnum, gden = zip(*(gini_parts_np(row) for row in stack))
+    return {
+        "deciles": dec,                                   # [P, 10] i64
+        "top_idx": np.stack(idx),                         # [P, k]  i32
+        "top_val": np.stack(val),                         # [P, k]  i64
+        "gini_num": np.asarray(gnum, np.int64),           # [P]     i64
+        "gini_den": np.asarray(gden, np.int64),           # [P]     i64
+    }
+
+
+# --------------------------------------------------------------------------
+# the on-device digest (lazy-JAX; one dispatch per measured block)
+# --------------------------------------------------------------------------
+
+@lru_cache(maxsize=None)
+def _device_digest_fn(k: int):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(stack, decile_ids):
+        # stack [P, N] i32/i64 -> everything the host ever reads is
+        # [P, 10] / [P, k] / [P]: zero O(N) host transfers.
+        stack = stack.astype(jnp.int64)
+        dec = jax.ops.segment_sum(
+            stack.T, decile_ids.astype(jnp.int32),
+            num_segments=NUM_DECILES).T                   # [P, 10] i64
+        top_val, top_idx = jax.lax.top_k(stack, k)        # ties -> low id
+        xs = jnp.sort(stack, axis=-1)
+        n = stack.shape[-1]
+        w = 2 * jnp.arange(1, n + 1, dtype=jnp.int64) - n - 1
+        gnum = jnp.sum(w[None, :] * xs, axis=-1)
+        gden = n * jnp.sum(xs, axis=-1)
+        return dec, top_idx.astype(jnp.int32), top_val, gnum, gden
+
+    return run
+
+
+def digest_stack(stack, decile_ids, k: int) -> dict:
+    """On-device digest of a [P, N] metric stack (device arrays in, small
+    host numpy arrays out).  Bit-identical to :func:`digest_stack_np` on
+    the same integers."""
+    import jax
+    n = int(np.shape(stack)[-1])
+    k = min(int(k), n)
+    if not jax.config.jax_enable_x64:
+        # without x64 the device i64 sums would silently truncate to i32
+        # and the exact-integer parity contract breaks — engine callers
+        # always have x64 (engine/__init__ flips it on import), so this
+        # fallback only covers digesting outside an engine process
+        return digest_stack_np(np.asarray(stack), np.asarray(decile_ids), k)
+    dec, idx, val, gnum, gden = _device_digest_fn(k)(stack, decile_ids)
+    return {
+        "deciles": np.asarray(dec),
+        "top_idx": np.asarray(idx),
+        "top_val": np.asarray(val),
+        "gini_num": np.asarray(gnum),
+        "gini_den": np.asarray(gden),
+    }
+
+
+# --------------------------------------------------------------------------
+# report / wire assembly (host-side, numpy-only)
+# --------------------------------------------------------------------------
+
+def build_node_health_section(metric_names, digest, *, enabled: bool,
+                              topk: int, source: str,
+                              latency: dict | None = None,
+                              extra: dict | None = None) -> dict:
+    """Assemble the REQUIRED ``node_health`` run-report section.
+
+    ``digest`` is a :func:`digest_stack` / :func:`digest_stack_np` result
+    whose row order matches ``metric_names``.  ``latency`` optionally
+    carries the decile coverage-latency table ({"lat_sum": [10],
+    "delivered": [10]} style pairs already reduced to deciles).  When the
+    gate is off the section still exists (schema + enabled=False) so
+    ``validate_run_report`` holds on every run."""
+    section: dict = {
+        "schema": HEALTH_SCHEMA,
+        "enabled": bool(enabled),
+        "topk": int(topk),
+        "source": str(source),
+        "metrics": {},
+    }
+    if not enabled or digest is None:
+        return section
+    for i, name in enumerate(metric_names):
+        section["metrics"][name] = {
+            "total": int(digest["deciles"][i].sum()),
+            "deciles": [int(x) for x in digest["deciles"][i]],
+            "hot_nodes": [
+                {"node": int(a), "count": int(b)}
+                for a, b in zip(digest["top_idx"][i], digest["top_val"][i])
+            ],
+            "gini": gini_value(int(digest["gini_num"][i]),
+                               int(digest["gini_den"][i])),
+        }
+    if latency:
+        section["latency"] = latency
+    if extra:
+        section.update(extra)
+    return section
+
+
+def influx_values(metric_names, digest, *, topk: int) -> dict:
+    """Flatten a digest into the ``sim_node_health`` point's field dict
+    (sorted-key emission happens in sinks/influx.py).  Totals and Gini
+    per metric, plus the hot-node (id, count) pairs of every metric so
+    drop attribution is replayable per block."""
+    vals: dict = {}
+    for i, name in enumerate(metric_names):
+        vals[f"{name}_total"] = int(digest["deciles"][i].sum())
+        vals[f"{name}_gini"] = gini_value(int(digest["gini_num"][i]),
+                                          int(digest["gini_den"][i]))
+        for j in range(min(int(topk), digest["top_idx"].shape[1])):
+            vals[f"{name}_hot{j}_node"] = int(digest["top_idx"][i, j])
+            vals[f"{name}_hot{j}_count"] = int(digest["top_val"][i, j])
+    return vals

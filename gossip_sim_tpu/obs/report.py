@@ -111,6 +111,7 @@ REQUIRED_KEYS = {
     "compilation_cache": dict,
     "resilience": dict,
     "capacity": dict,
+    "node_health": dict,
 }
 
 
@@ -241,6 +242,7 @@ def build_run_report(config, registry, *, stats: dict | None = None,
         "stats": dict(stats or {}),
         "compilation_cache": _compilation_cache_section(info),
         "capacity": _capacity_section(info),
+        "node_health": _node_health_section(info),
         # resilient-execution accounting (resilience.py): journal units
         # committed this run, units replayed from a prior run's journal,
         # supervised dispatch failures and CPU-fallback re-executions —
@@ -274,6 +276,22 @@ def _capacity_section(info: dict) -> dict:
         }
     except Exception:  # pragma: no cover - report must never kill a run
         return {"ledger": {}, "cost": {}, "memwatch": {}}
+
+
+def _node_health_section(info: dict) -> dict:
+    """Node-health observatory section (obs/health.py): the digest dict
+    the run path stamped into registry info when ``--health`` was on.
+    Gated-off runs still carry the section (enabled=False) so the
+    REQUIRED-key schema holds on every report."""
+    try:
+        from .health import HEALTH_SCHEMA
+        section = info.get("node_health")
+        if section:
+            return dict(section)
+        return {"schema": HEALTH_SCHEMA, "enabled": False, "topk": 0,
+                "source": "", "metrics": {}}
+    except Exception:  # pragma: no cover - report must never kill a run
+        return {"enabled": False, "metrics": {}}
 
 
 def _compilation_cache_section(info: dict) -> dict:

@@ -55,6 +55,10 @@ def state_shardings(mesh: Mesh, shard_nodes: bool = True) -> dict:
         # the node axis like hops_hist_acc, rescue counts shard with it
         "pull_hops_hist_acc": P("origins"),
         "pull_rescued_acc": P("origins", n),
+        # node-health observatory planes (obs/health.py): [O, N], shard
+        # with the other per-node accumulators
+        "health_prune_recv": P("origins", n),
+        "health_first_round": P("origins", n),
         # adaptive direction bit (adaptive.py): [O], per-origin-sim
         "adaptive_pull_on": P("origins"),
     }

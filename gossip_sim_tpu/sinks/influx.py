@@ -84,17 +84,20 @@ class DatapointQueue:
     def drain_deterministic_lines(self) -> list:
         """Drain the queue into its deterministic wire payload: every line
         with the per-point ns timestamp (the trailing token) stripped and
-        the wall-clock-valued series (``sim_perf``, ``sim_capacity``)
-        dropped.  This is THE normalized form two runs of the same
-        simulation must agree on — the lane-sweep parity tests and
-        tools/lane_smoke.py both diff it, so the Influx bit-exactness
-        contract has one definition."""
+        the telemetry-only series (``sim_perf``, ``sim_capacity``,
+        ``sim_node_health``) dropped — the first two are wall-clock-
+        valued, the third exists only under the opt-in ``--health`` gate,
+        and none of the three may perturb simulation parity.  This is THE
+        normalized form two runs of the same simulation must agree on —
+        the lane-sweep parity tests and tools/lane_smoke.py both diff it,
+        so the Influx bit-exactness contract has one definition."""
         lines = []
         while len(self):
             dp = self.pop_front()
             for ln in dp.data().splitlines():
                 if (not ln or ln.startswith("sim_perf")
-                        or ln.startswith("sim_capacity")):
+                        or ln.startswith("sim_capacity")
+                        or ln.startswith("sim_node_health")):
                     continue
                 lines.append(ln.rsplit(" ", 1)[0])
         return lines
@@ -389,6 +392,25 @@ class InfluxDataPoint:
         self.datapoint += (
             f"sim_capacity,simulation_iter={self.simulation_iteration},"
             f"start_time={self.start_timestamp} " + ",".join(parts) + " ")
+        self.append_timestamp()
+
+    def create_sim_node_health_point(self, block: int, values: dict):
+        """Node-health observatory series (obs/health.py): one point per
+        measured harvest block with the flattened digest — per-metric
+        totals, hot-node (id, count) pairs and load-imbalance Gini.  The
+        values themselves are deterministic integers, but the series only
+        exists under the opt-in ``--health`` gate, so
+        drain_deterministic_lines drops it alongside sim_perf /
+        sim_capacity — enabling health never moves a bit on the parity
+        wire surface."""
+        parts = []
+        for k, v in sorted(values.items()):
+            parts.append(f"{k}={float(v)}" if isinstance(v, float)
+                         else f"{k}={int(v)}")
+        self.datapoint += (
+            f"sim_node_health,simulation_iter={self.simulation_iteration},"
+            f"start_time={self.start_timestamp} "
+            f"block={int(block)}," + ",".join(parts) + " ")
         self.append_timestamp()
 
     def create_messages_point(self, messages_direction: str, messages,

@@ -138,6 +138,10 @@ class TrafficStats:
                          "prunes_sent", "retired", "converged",
                          "hop_clamped")}
         causes = [r.get("cause") for r in recs]
+        pull_qdrop = int(np.sum(self.adaptive_rounds["pull_queue_dropped"],
+                                dtype=np.int64))
+        pull_def = int(np.sum(self.adaptive_rounds["pull_deferred"],
+                              dtype=np.int64))
         out = {
             "measured_rounds": len(self.iterations),
             "values_injected": tot["injected"],
@@ -161,6 +165,15 @@ class TrafficStats:
             "suppressed": tot["suppressed"],
             "queue_deferred": tot["deferred"],
             "queue_dropped": tot["queue_dropped"],
+            # queue-drop side attribution (node health observatory): the
+            # ingress side is everything the receiver-cap sort discarded —
+            # push arrivals over node_ingress_cap plus pull requests over
+            # the serving peer's remaining budget (exactly what qdrop_acc
+            # accumulates per node); the egress side is the sender-cap
+            # deferrals (defer_acc).  "queue_dropped" above keeps its
+            # historical push-only meaning.
+            "queue_dropped_ingress": tot["queue_dropped"] + pull_qdrop,
+            "queue_deferred_egress": tot["deferred"] + pull_def,
             "prunes_sent": tot["prunes_sent"],
             "hop_clamped": tot["hop_clamped"],
             "qdepth_max": int(max(self.rounds["qdepth_max"], default=0)),

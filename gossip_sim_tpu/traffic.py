@@ -260,6 +260,18 @@ class TrafficRound(NamedTuple):
     pull_rescued: int = 0        # first deliveries via pull this round
     pull_active_values: int = 0  # live values in their pull phase
     switched_to_pull: int = 0    # values flipping push -> pull this round
+    # node-health observatory planes (obs/health.py): the oracle twins of
+    # the engine's TrafficState health accumulators, filled every round by
+    # run_round (trailing defaults keep hand-built rounds constructing);
+    # the 1k-node parity test diffs their warm-gated sums bit-for-bit
+    node_sent: np.ndarray = None        # [N] i64 wire messages per sender
+    node_recv: np.ndarray = None        # [N] i64 accepted per receiver
+    node_prune_sent: np.ndarray = None  # [N] i64 prunes per pruner
+    node_prune_recv: np.ndarray = None  # [N] i64 prunes per prunee
+    node_delivered: np.ndarray = None   # [N] i64 first deliveries
+    node_lat_sum: np.ndarray = None     # [N] i64 sum of first-delivery
+    #                                     latencies (it - birth + 1)
+    node_rescued: np.ndarray = None     # [N] i64 pull-rescue deliveries
 
 
 #: terminal causes a retirement record carries (the starvation
@@ -459,6 +471,14 @@ class TrafficOracle:
         egress_used = np.zeros(n, np.int64)
         node_deferred = np.zeros(n, np.int64)
         node_qdrop = np.zeros(n, np.int64)
+        # node-health planes (engine TrafficState health twins)
+        node_sent = np.zeros(n, np.int64)
+        node_recv = np.zeros(n, np.int64)
+        node_prune_sent = np.zeros(n, np.int64)
+        node_prune_recv = np.zeros(n, np.int64)
+        node_delivered = np.zeros(n, np.int64)
+        node_lat_sum = np.zeros(n, np.int64)
+        node_rescued = np.zeros(n, np.int64)
         sends = deferred = failed_target = suppressed = dropped = 0
         pull_active_values = sum(
             1 for m in live_slots if self.slots[m]["pull"])
@@ -490,6 +510,7 @@ class TrafficOracle:
                         continue
                     egress_used[src] += 1
                     sends += 1
+                    node_sent[src] += 1
                     if self.failed[peer]:
                         failed_target += 1
                         continue
@@ -514,6 +535,7 @@ class TrafficOracle:
                 self.slots[m]["qdrop"] += 1
                 continue
             ingress_used[dst] += 1
+            node_recv[dst] += 1
             accepted.append((m, src, dst))
 
         # ---- adaptive pull-rescue phase (adaptive.py) -------------------
@@ -550,12 +572,15 @@ class TrafficOracle:
                         continue
                     fp_d = bool(self.pull_fp_thr
                                 and node_u32(vb_b, d) < self.pull_fp_thr)
-                    for s in range(min(self.pull_fanout, self.pull_slots)):
+                    # NB: the slot index must NOT be named ``s`` — that
+                    # would clobber the active-set size the prune-apply
+                    # and rotation loops below still read this round
+                    for ps in range(min(self.pull_fanout, self.pull_slots)):
                         peer = int(class_draw_arr(
                             self.tables,
-                            np.asarray([u01_from_u32(edge_u32(vb_c, d, s))],
+                            np.asarray([u01_from_u32(edge_u32(vb_c, d, ps))],
                                        np.float32),
-                            np.asarray([u01_from_u32(edge_u32(vb_m, d, s))],
+                            np.asarray([u01_from_u32(edge_u32(vb_m, d, ps))],
                                        np.float32))[0])
                         if peer == d:
                             continue   # self-draw: slot discarded
@@ -565,6 +590,7 @@ class TrafficOracle:
                             continue
                         egress_used[d] += 1
                         pull_sent += 1
+                        node_sent[d] += 1
                         if self.failed[peer]:
                             pull_failed_target += 1
                             continue
@@ -585,10 +611,15 @@ class TrafficOracle:
                     continue
                 ingress_used[peer] += 1
                 pull_served += 1
+                node_recv[peer] += 1
                 v = self.slots[m]
                 v["m"] += 1
                 if v["holder"][peer] and not fp_d:
                     pull_responses += 1
+                    # a response is peer egress + requester ingress (the
+                    # engine's resp_peer / resp_in accounting)
+                    node_sent[peer] += 1
+                    node_recv[d] += 1
                     v["m"] += 1
                     th = int(v["hop"][peer]) + 1
                     key = (min(th, self.hist_bins - 1),
@@ -640,6 +671,8 @@ class TrafficOracle:
             v = self.slots[m]
             v["holder"][dst] = True
             v["hop"][dst] = hp
+            node_delivered[dst] += 1
+            node_lat_sum[dst] += it - v["birth"] + 1
         # first deliveries = new (value, node) pairs; every further
         # accepted copy (same-round duplicates included) is redundant
         delivered = len(new_hops)
@@ -655,6 +688,9 @@ class TrafficOracle:
             v["hop"][dst] = ch
             v["rescued"] += 1
             pull_rescued_cnt += 1
+            node_delivered[dst] += 1
+            node_lat_sum[dst] += it - v["birth"] + 1
+            node_rescued[dst] += 1
             progress[m] += 1
             if clamp:
                 hop_clamped += 1
@@ -679,6 +715,8 @@ class TrafficOracle:
                             and src != v["origin"]):
                         prunes_sent += 1
                         v["m"] += 1
+                        node_prune_sent[pruner] += 1
+                        node_prune_recv[src] += 1
                         # prune apply: every shared slot of src that
                         # points at the pruner gets the per-value bit
                         for slot in range(s):
@@ -770,6 +808,11 @@ class TrafficOracle:
             inflow_max=int(ingress_used.max()) if n else 0,
             records=records, node_deferred=node_deferred,
             node_queue_dropped=node_qdrop,
+            node_sent=node_sent, node_recv=node_recv,
+            node_prune_sent=node_prune_sent,
+            node_prune_recv=node_prune_recv,
+            node_delivered=node_delivered, node_lat_sum=node_lat_sum,
+            node_rescued=node_rescued,
             pull_sent=pull_sent, pull_deferred=pull_deferred,
             pull_failed_target=pull_failed_target,
             pull_suppressed=pull_suppressed, pull_dropped=pull_dropped,

@@ -1,4 +1,4 @@
-"""Generator for the committed v1-v7 checkpoint fixtures (run once).
+"""Generator for the committed v1-v8 checkpoint fixtures (run once).
 
 The fixtures pin the forward-compat contract: every checkpoint format the
 project ever shipped must stay loadable by ``load_state`` /
@@ -6,8 +6,9 @@ project ever shipped must stay loadable by ``load_state`` /
 are COMMITTED BINARIES — regenerating them with a newer engine would
 defeat the point, so this script exists only to document how they were
 made (v1-v4: v5-era engine, 2026-08; v5: v6-era engine, 2026-08; v6: the
-v7-era engine, 2026-08, with the adaptive direction bit stripped — the
-push-mode fixture dynamics are bit-identical between those eras, so each
+v7-era engine, 2026-08, with the adaptive direction bit stripped; v7: the
+v8-era engine, 2026-08, with the health planes stripped — the push-mode
+fixture dynamics are bit-identical between those eras, so each
 file is byte-faithful to what its own era's writer produced) and to
 rebuild them if the fixture cluster spec itself ever has to change
 (requires re-validating against the old loaders).  Existing fixture files
@@ -38,7 +39,8 @@ HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "checkpoints")
 
 # fields each era's SimState did NOT yet have
-PRE_V7_MISSING = ("adaptive_pull_on",)
+PRE_V8_MISSING = ("health_prune_recv", "health_first_round")
+PRE_V7_MISSING = ("adaptive_pull_on",) + PRE_V8_MISSING
 V1_MISSING = ("tfail", "rc_shi", "rc_slo",
               "pull_hops_hist_acc", "pull_rescued_acc") + PRE_V7_MISSING
 PRE_V4_MISSING = ("pull_hops_hist_acc", "pull_rescued_acc") + PRE_V7_MISSING
@@ -51,6 +53,8 @@ TRAFFIC_KEYS = ("traffic_values", "traffic_rate", "node_ingress_cap",
                 "node_egress_cap", "traffic_stall_rounds")
 # v7 (adaptive push-pull) params that did not exist in the v6 era
 ADAPTIVE_KEYS = ("adaptive_switch_threshold", "adaptive_switch_hysteresis")
+# v8 (node-health observatory) params that did not exist in the v7 era
+HEALTH_KEYS = ("health",)
 
 
 def main():
@@ -91,7 +95,7 @@ def main():
     impair = {k: pdict[k] for k in IMPAIR_KEYS}
     pull = {k: pdict[k] for k in PULL_KEYS if k != "pull_slots"}
     traffic = {k: pdict[k] for k in TRAFFIC_KEYS}
-    old = ADAPTIVE_KEYS  # params no pre-v7 era ever wrote
+    old = ADAPTIVE_KEYS + HEALTH_KEYS  # params no pre-v7 era ever wrote
     write(1, V1_MISSING, IMPAIR_KEYS + PULL_KEYS + TRAFFIC_KEYS + old, {})
     write(2, PRE_V4_MISSING, IMPAIR_KEYS + PULL_KEYS + TRAFFIC_KEYS + old,
           {})
@@ -110,10 +114,19 @@ def main():
           {"impair": impair, "pull": pull, "traffic": traffic,
            "resilience": {"journal": "", "committed_units": 0},
            "kind": "sim"})
-    # v7 (current): the full array set + the adaptive meta block
-    write(7, (), (),
+    # v7 (PR 12 era): adaptive meta block; the health planes / gate of v8
+    # do not exist yet
+    write(7, PRE_V8_MISSING, HEALTH_KEYS,
           {"impair": impair, "pull": pull, "traffic": traffic,
            "adaptive": {k: pdict[k] for k in ADAPTIVE_KEYS},
+           "resilience": {"journal": "", "committed_units": 0},
+           "kind": "sim"})
+    # v8 (current): the full array set + the health meta block — the
+    # gated-off engine carries the health planes as exact zeros
+    write(8, (), (),
+          {"impair": impair, "pull": pull, "traffic": traffic,
+           "adaptive": {k: pdict[k] for k in ADAPTIVE_KEYS},
+           "health": {k: pdict[k] for k in HEALTH_KEYS},
            "resilience": {"journal": "", "committed_units": 0},
            "kind": "sim"})
 

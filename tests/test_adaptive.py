@@ -477,7 +477,9 @@ class TestAdaptiveCheckpoint:
         straight, rows_a = run_traffic_rounds(p, tables, tt, st, 4,
                                               start_it=6)
         restored, _, meta = restore_traffic_state(path, p)
-        assert meta["format_version"] == 7
+        # current writer version (v8 as of ISSUE 17); the
+        # adaptive arrays ride along in every later format
+        assert meta["format_version"] >= 7
         assert meta["adaptive"]["adaptive_switch_threshold"] == 0.5
         resumed, rows_b = run_traffic_rounds(p, tables, tt, restored, 4,
                                              start_it=6)

@@ -113,7 +113,7 @@ def test_v3_checkpoint_records_impair_block(tmp_path):
     path = str(tmp_path / "ckpt.npz")
     save_state(path, state, params, iteration=4)
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 7
+    assert meta["format_version"] == 8
     assert meta["impair"] == {
         "packet_loss_rate": 0.25, "churn_fail_rate": 0.01,
         "churn_recover_rate": 0.5, "partition_at": 3, "heal_at": 8,
@@ -236,13 +236,13 @@ def test_impair_knob_mismatch_warns_on_resume(tmp_path, caplog):
 FIXTURE_DIR = __file__.rsplit("/", 1)[0] + "/fixtures/checkpoints"
 
 
-@pytest.mark.parametrize("version", [1, 2, 3, 4, 5, 6, 7])
+@pytest.mark.parametrize("version", [1, 2, 3, 4, 5, 6, 7, 8])
 def test_checkpoint_forward_compat_matrix(version):
-    """Committed v1-v7 fixture files (tests/fixtures/checkpoints, frozen
+    """Committed v1-v8 fixture files (tests/fixtures/checkpoints, frozen
     binaries from each format era) must load and restore forever — a new
     format can never silently orphan old checkpoints (ISSUE 7; v5 joined
     the matrix when checkpoint v6 landed, ISSUE 10; v6 when v7 landed,
-    ISSUE 11).  Each fixture must
+    ISSUE 11; v7 when v8 landed, ISSUE 17).  Each fixture must
     (a) pass load_state's validation against current EngineParams,
     (b) restore to a full SimState with the era-appropriate backfills,
     (c) continue running on the current engine."""
@@ -289,6 +289,11 @@ def test_checkpoint_forward_compat_matrix(version):
     if version < 7:
         # the adaptive direction bit did not exist — exact zero backfill
         assert not np.asarray(restored.adaptive_pull_on).any()
+    # pre-v8 backfill: the health planes did not exist, and the gated-off
+    # v8 writer carries them as identical zeros — either way, exact zeros
+    assert not np.asarray(restored.health_prune_recv).any()
+    assert not np.asarray(restored.health_first_round).any()
+    assert meta["health"]["health"] is False
     # the restored state must continue on the current engine
     origins = jnp.arange(1, dtype=jnp.int32)
     state, rows = run_rounds(params, tables, origins, restored, 2,
@@ -303,7 +308,7 @@ def test_v5_checkpoint_records_resilience_block(tmp_path):
     save_state(path, state, params, iteration=2,
                resilience={"journal": "ckpt.journal", "committed_units": 3})
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 7
+    assert meta["format_version"] == 8
     assert meta["resilience"] == {"journal": "ckpt.journal",
                                   "committed_units": 3}
 
@@ -363,7 +368,7 @@ def test_v6_traffic_checkpoint_roundtrip_and_kind_guard(tmp_path):
                        traffic_stats=stats_state)
     restored, stored, meta = restore_traffic_state(path, tparams)
     assert meta["kind"] == "traffic"
-    assert meta["format_version"] == 7
+    assert meta["format_version"] == 8
     assert meta["traffic"]["traffic_values"] == 3
     assert meta["traffic_stats"]["iterations"] == [0, 1, 2]
     for f, a, b in zip(restored._fields, restored, tstate):
@@ -381,3 +386,72 @@ def test_v6_traffic_checkpoint_roundtrip_and_kind_guard(tmp_path):
     save_state(sim_path, state, params, iteration=1)
     with pytest.raises(ValueError, match="sim"):
         restore_traffic_state(sim_path)
+
+
+def test_v8_checkpoint_roundtrips_nonzero_health_planes(tmp_path):
+    """A health-gated sim run accumulates nonzero health planes; a v8
+    checkpoint must carry them through save/restore bit-exactly and
+    record the gate in the health meta block (ISSUE 17)."""
+    params, tables, origins, state = _setup()
+    params = params._replace(health=True)
+    state = state._replace(
+        health_prune_recv=state.health_prune_recv + 3,
+        health_first_round=state.health_first_round + 7)
+    path = str(tmp_path / "v8.npz")
+    save_state(path, state, params, iteration=4)
+    restored, _, meta = restore_sim_state(path, params)
+    assert meta["format_version"] == 8
+    assert meta["health"] == {"health": True}
+    np.testing.assert_array_equal(np.asarray(restored.health_prune_recv),
+                                  np.asarray(state.health_prune_recv))
+    np.testing.assert_array_equal(np.asarray(restored.health_first_round),
+                                  np.asarray(state.health_first_round))
+
+
+def test_pre_v8_traffic_checkpoint_backfills_health_planes(tmp_path):
+    """A v7-era traffic checkpoint (no health planes) must restore with
+    exact zero backfill — the gated-off engine never incremented them."""
+    import json as _json
+
+    from gossip_sim_tpu.checkpoint import (restore_traffic_state,
+                                           save_traffic_state)
+    from gossip_sim_tpu.engine.traffic import init_traffic_state
+
+    rng = np.random.default_rng(9)
+    stakes = rng.integers(1, 1 << 16, 16).astype(np.int64) * 10**9
+    tparams = EngineParams(num_nodes=16, traffic_values=3,
+                           warm_up_rounds=0).validate()
+    tstate = init_traffic_state(stakes, tparams, seed=3)
+    path = str(tmp_path / "traffic_v7.npz")
+    save_traffic_state(path, tstate, tparams, iteration=1)
+    # rewrite as a v7-era file: strip the health arrays + meta block
+    health = ("health_prune_recv", "health_lat_acc", "health_del_acc",
+              "health_rescued_acc")
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != "__meta__"
+                  and k[len("state."):] not in health}
+        meta = _json.loads(bytes(z["__meta__"]).decode())
+    meta["format_version"] = 7
+    meta.pop("health", None)
+    meta["params"].pop("health", None)
+    with open(path, "wb") as f:
+        np.savez_compressed(f, __meta__=np.frombuffer(
+            _json.dumps(meta).encode(), dtype=np.uint8), **arrays)
+    restored, _, meta2 = restore_traffic_state(path, tparams)
+    assert meta2["health"] == {"health": False}
+    for fld in health:
+        plane = np.asarray(getattr(restored, fld))
+        assert plane.shape == (16,) and not plane.any(), fld
+
+
+def test_health_gate_mismatch_warns_on_resume(tmp_path, caplog):
+    """Resuming a gate-off checkpoint with --health on (or vice versa)
+    must warn: the planes only cover rounds run under an enabled gate."""
+    import logging
+
+    params, tables, origins, state = _setup()
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, params)     # health=False default
+    with caplog.at_level(logging.WARNING):
+        restore_sim_state(path, params._replace(health=True))
+    assert any("health planes" in r.message for r in caplog.records)

@@ -1,0 +1,537 @@
+"""Node-health observatory tests (ISSUE 17).
+
+Covers the obs/health.py + engine health-plane contract:
+
+* **Digest parity** — the on-device digest (segment-sum deciles, top-k
+  hot nodes, exact-integer Gini) is bit-identical to the numpy twin on
+  the same integers, including lexsort tie-breaks and i64-range sums.
+* **Plane parity** — the engine's gated [N] health accumulators match a
+  loop-based ``TrafficOracle`` recount bit-for-bit, in push mode and in
+  adaptive mode with prunes + pull rescues actually firing; the slow
+  marker carries the 1k-node loss+churn acceptance regime.
+* **Gating** — ``--health`` off leaves every non-health output
+  bit-identical and every plane identically zero (the planes are carried
+  fields, so snapshot shapes never change).
+* **Digest invariants** — decile sums equal the cluster aggregate, the
+  report section and wire point have their contracted shapes, and the
+  ``sim_node_health`` series stays off the deterministic wire surface.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import numpy as np
+import pytest
+
+from gossip_sim_tpu.engine import make_cluster_tables
+from gossip_sim_tpu.engine.params import EngineParams
+from gossip_sim_tpu.engine.traffic import (device_traffic_tables,
+                                           init_traffic_state,
+                                           run_traffic_rounds)
+from gossip_sim_tpu.obs import health
+from gossip_sim_tpu.traffic import TrafficOracle
+
+#: engine TrafficState plane -> TrafficOracle per-round recount field
+PLANE_TO_ORACLE = {
+    "sent_acc": "node_sent",
+    "recv_acc": "node_recv",
+    "defer_acc": "node_deferred",
+    "qdrop_acc": "node_queue_dropped",
+    "prune_acc": "node_prune_sent",
+    "health_prune_recv": "node_prune_recv",
+    "health_lat_acc": "node_lat_sum",
+    "health_del_acc": "node_delivered",
+    "health_rescued_acc": "node_rescued",
+}
+
+HEALTH_PLANES = ("health_prune_recv", "health_lat_acc", "health_del_acc",
+                 "health_rescued_acc")
+
+
+def _stakes(n, seed=3):
+    rng = np.random.default_rng(seed)
+    return rng.choice(np.arange(1, 50 * n), size=n,
+                      replace=False).astype(np.int64) * 10**6
+
+
+def _oracle_kwargs(params: EngineParams) -> dict:
+    kw = dict(
+        impair_seed=params.impair_seed,
+        traffic_values=params.traffic_values,
+        traffic_rate=params.traffic_rate,
+        node_ingress_cap=params.node_ingress_cap,
+        node_egress_cap=params.node_egress_cap,
+        traffic_stall_rounds=params.traffic_stall_rounds,
+        push_fanout=params.push_fanout,
+        active_set_size=params.active_set_size,
+        min_num_upserts=params.min_num_upserts,
+        probability_of_rotation=params.probability_of_rotation,
+        packet_loss_rate=params.packet_loss_rate,
+        churn_fail_rate=params.churn_fail_rate,
+        churn_recover_rate=params.churn_recover_rate)
+    if params.gossip_mode == "adaptive":
+        kw.update(gossip_mode="adaptive",
+                  adaptive_switch_threshold=params.adaptive_switch_threshold,
+                  adaptive_switch_hysteresis=params.adaptive_switch_hysteresis)
+    return kw
+
+
+def _run_both(params, stakes, rounds, seed):
+    """Engine final state + the oracle's summed per-node recounts."""
+    tables = make_cluster_tables(stakes)
+    tt = device_traffic_tables(stakes)
+    st = init_traffic_state(stakes, params, seed)
+    st, _ = run_traffic_rounds(params, tables, tt, st, rounds)
+
+    orc = TrafficOracle(stakes, seed=seed, **_oracle_kwargs(params))
+    acc = {f: np.zeros(len(stakes), np.int64) for f in PLANE_TO_ORACLE}
+    for it in range(rounds):
+        tr = orc.run_round(it)
+        for plane, fld in PLANE_TO_ORACLE.items():
+            acc[plane] += getattr(tr, fld)
+    return st, acc
+
+
+def _assert_plane_parity(params, stakes, rounds, seed):
+    st, acc = _run_both(params, stakes, rounds, seed)
+    for plane in PLANE_TO_ORACLE:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st, plane)), acc[plane], err_msg=plane)
+    return st, acc
+
+
+# --------------------------------------------------------------------------
+# digest math: device vs numpy twin
+# --------------------------------------------------------------------------
+
+class TestDigest:
+    def test_stake_decile_ids_matches_cluster_tables(self):
+        stakes = _stakes(997)
+        np.testing.assert_array_equal(
+            health.stake_decile_ids(stakes),
+            np.asarray(make_cluster_tables(stakes).stake_decile))
+
+    def test_decile_ids_tie_break_by_node_id(self):
+        # equal stakes: the stable sort ranks lower node ids first
+        ids = health.stake_decile_ids(np.full(20, 7, np.int64))
+        np.testing.assert_array_equal(ids, np.arange(20) // 2)
+
+    def test_device_digest_matches_numpy_twin(self):
+        rng = np.random.default_rng(11)
+        n, p = 1000, 9
+        # counts to ~300k: the Gini numerator reaches ~1e12, well past
+        # i32 — this is exactly the x64 regime the engine runs in
+        stack = rng.integers(0, 300_000, size=(p, n)).astype(np.int64)
+        stack[2, 100:110] = stack.max() + 5   # forced hot nodes + ties
+        stack[3] = 0                          # degenerate all-zero plane
+        ids = health.stake_decile_ids(_stakes(n))
+        k = 10
+        dv = health.digest_stack(stack, ids, k)
+        nv = health.digest_stack_np(stack, ids, k)
+        for key in nv:
+            np.testing.assert_array_equal(dv[key], nv[key], err_msg=key)
+
+    def test_topk_ties_break_toward_lower_node_id(self):
+        idx, val = health.topk_nodes_np(np.array([5, 9, 9, 1, 9]), 3)
+        np.testing.assert_array_equal(idx, [1, 2, 4])
+        np.testing.assert_array_equal(val, [9, 9, 9])
+
+    def test_gini_known_values(self):
+        num, den = health.gini_parts_np(np.full(8, 3))
+        assert health.gini_value(num, den) == 0.0       # uniform load
+        num, den = health.gini_parts_np([0] * 9 + [90])
+        assert health.gini_value(num, den) == pytest.approx(0.9)
+        assert health.gini_value(0, 0) == 0.0           # empty plane
+
+    def test_decile_sums_equal_cluster_aggregate(self):
+        rng = np.random.default_rng(4)
+        plane = rng.integers(0, 1000, 503)
+        ids = health.stake_decile_ids(_stakes(503))
+        dec = health.decile_sums_np(plane, ids)
+        assert dec.sum() == plane.sum()
+        assert dec.shape == (health.NUM_DECILES,)
+
+
+# --------------------------------------------------------------------------
+# engine plane parity vs the loop oracle
+# --------------------------------------------------------------------------
+
+class TestPlaneParity:
+    def test_push_mode_planes_match_oracle(self):
+        n = 64
+        params = EngineParams(
+            num_nodes=n, traffic_values=4, traffic_rate=2,
+            node_ingress_cap=6, node_egress_cap=10,
+            traffic_stall_rounds=2, warm_up_rounds=0,
+            probability_of_rotation=0.2, impair_seed=99,
+            packet_loss_rate=0.15, churn_fail_rate=0.03,
+            churn_recover_rate=0.3, min_num_upserts=3,
+            health=True).validate()
+        st, acc = _assert_plane_parity(params, _stakes(n), 10, seed=7)
+        assert acc["sent_acc"].sum() > 0
+        assert acc["health_del_acc"].sum() > 0
+
+    def test_adaptive_mode_planes_match_oracle_with_rescues(self):
+        """Prunes AND pull rescues fire, so the prune-recv / rescued /
+        latency planes all take the bursty code paths."""
+        n = 120
+        params = EngineParams(
+            num_nodes=n, warm_up_rounds=0, gossip_mode="adaptive",
+            impair_seed=7, adaptive_switch_threshold=0.6,
+            adaptive_switch_hysteresis=0.1, traffic_values=6,
+            traffic_rate=2, node_ingress_cap=24, node_egress_cap=32,
+            traffic_stall_rounds=4, packet_loss_rate=0.1,
+            churn_fail_rate=0.02, churn_recover_rate=0.25,
+            min_num_upserts=4, health=True).validate()
+        st, acc = _assert_plane_parity(params, _stakes(n), 30, seed=11)
+        assert acc["prune_acc"].sum() > 0, "regime never pruned"
+        assert acc["health_rescued_acc"].sum() > 0, "regime never rescued"
+        # rescues are a subset of first deliveries, latencies only exist
+        # where deliveries do
+        assert (acc["health_rescued_acc"] <= acc["health_del_acc"]).all()
+        assert (acc["health_lat_acc"][acc["health_del_acc"] == 0] == 0).all()
+
+    @pytest.mark.slow  # ISSUE 17 acceptance regime; health_smoke covers it
+    def test_exact_parity_1k_nodes_under_faults(self):
+        n = 1024
+        params = EngineParams(
+            num_nodes=n, traffic_values=16, traffic_rate=3,
+            node_ingress_cap=24, node_egress_cap=48,
+            traffic_stall_rounds=3, warm_up_rounds=0,
+            probability_of_rotation=0.05, impair_seed=99,
+            packet_loss_rate=0.15, churn_fail_rate=0.03,
+            churn_recover_rate=0.3, min_num_upserts=5,
+            health=True).validate()
+        st, acc = _assert_plane_parity(params, _stakes(n), 6, seed=7)
+        assert acc["qdrop_acc"].sum() > 0, "no contention in regime"
+        # the digest of the real planes also agrees device vs numpy
+        ids = health.stake_decile_ids(_stakes(n))
+        stack = np.stack([np.asarray(getattr(st, p), np.int64)
+                          for p in PLANE_TO_ORACLE])
+        dv = health.digest_stack(stack, ids, 10)
+        nv = health.digest_stack_np(stack, ids, 10)
+        for key in nv:
+            np.testing.assert_array_equal(dv[key], nv[key], err_msg=key)
+
+
+# --------------------------------------------------------------------------
+# gating: --health off is bit-identical and all-zero
+# --------------------------------------------------------------------------
+
+class TestGating:
+    KW = dict(traffic_values=4, traffic_rate=2, node_ingress_cap=6,
+              node_egress_cap=10, traffic_stall_rounds=2,
+              warm_up_rounds=0, impair_seed=99, packet_loss_rate=0.1,
+              churn_fail_rate=0.02, churn_recover_rate=0.3,
+              min_num_upserts=3)
+
+    def test_health_is_a_static_compile_key(self):
+        on = EngineParams(num_nodes=16, health=True).validate()
+        off = EngineParams(num_nodes=16, health=False).validate()
+        assert on.static_part() != off.static_part()
+        assert on.static_part().health is True
+
+    def test_traffic_gate_off_bit_identical_and_zero_planes(self):
+        n = 64
+        stakes = _stakes(n)
+        tables = make_cluster_tables(stakes)
+        tt = device_traffic_tables(stakes)
+
+        def run(health_on):
+            p = EngineParams(num_nodes=n, health=health_on,
+                             **self.KW).validate()
+            st = init_traffic_state(stakes, p, seed=7)
+            st, rows = run_traffic_rounds(p, tables, tt, st, 8)
+            return st, jax.tree_util.tree_map(np.asarray, rows)
+
+        s_on, r_on = run(True)
+        s_off, r_off = run(False)
+        assert set(r_on) == set(r_off)
+        for k in r_on:
+            np.testing.assert_array_equal(r_on[k], r_off[k], err_msg=k)
+        for f in s_on._fields:
+            if f in HEALTH_PLANES:
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(getattr(s_on, f)), np.asarray(getattr(s_off, f)),
+                err_msg=f)
+        # gated off, the planes are carried but never incremented
+        for f in HEALTH_PLANES:
+            assert not np.asarray(getattr(s_off, f)).any(), f
+        assert np.asarray(s_on.health_del_acc).sum() > 0
+
+    def test_sim_gate_off_bit_identical_and_zero_planes(self):
+        import jax.numpy as jnp
+
+        from gossip_sim_tpu.engine import init_state, run_rounds
+
+        n = 48
+        stakes = _stakes(n)
+        tables = make_cluster_tables(stakes)
+        origins = jnp.arange(2, dtype=jnp.int32)
+
+        def run(health_on):
+            p = EngineParams(num_nodes=n, warm_up_rounds=0,
+                             min_num_upserts=3, packet_loss_rate=0.1,
+                             impair_seed=5, health=health_on).validate()
+            st = init_state(jax.random.PRNGKey(3), tables, origins, p)
+            st, rows = run_rounds(p, tables, origins, st, 8)
+            return st, jax.tree_util.tree_map(np.asarray, rows)
+
+        s_on, r_on = run(True)
+        s_off, r_off = run(False)
+        for k in r_on:
+            np.testing.assert_array_equal(r_on[k], r_off[k], err_msg=k)
+        sim_planes = ("health_prune_recv", "health_first_round")
+        for f in s_on._fields:
+            if f in sim_planes:
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(getattr(s_on, f)), np.asarray(getattr(s_off, f)),
+                err_msg=f)
+        for f in sim_planes:
+            assert not np.asarray(getattr(s_off, f)).any(), f
+        # gated on: prune-recv attributes every prune the engine issued
+        assert (np.asarray(s_on.health_prune_recv).sum()
+                == np.asarray(s_on.prune_acc).sum())
+        # first-delivery rounds: origin reached at "round 1" (it 0 + 1),
+        # 0 means never reached; any reached node has a positive stamp
+        fr = np.asarray(s_on.health_first_round)
+        assert fr.max() >= 1
+        assert fr.min() >= 0
+
+
+# --------------------------------------------------------------------------
+# report section + wire point
+# --------------------------------------------------------------------------
+
+class TestReportAndWire:
+    def _digest(self):
+        rng = np.random.default_rng(2)
+        stack = rng.integers(0, 500, size=(3, 40)).astype(np.int64)
+        ids = health.stake_decile_ids(_stakes(40))
+        return ("a", "b", "c"), health.digest_stack_np(stack, ids, 5), stack
+
+    def test_section_shape(self):
+        names, dig, stack = self._digest()
+        sec = health.build_node_health_section(
+            names, dig, enabled=True, topk=5, source="engine-traffic")
+        assert sec["schema"] == health.HEALTH_SCHEMA
+        assert sec["enabled"] and sec["topk"] == 5
+        assert set(sec["metrics"]) == set(names)
+        m = sec["metrics"]["a"]
+        assert m["total"] == int(stack[0].sum())
+        assert len(m["deciles"]) == 10 and len(m["hot_nodes"]) == 5
+        assert m["hot_nodes"][0]["count"] >= m["hot_nodes"][-1]["count"]
+        assert 0.0 <= m["gini"] <= 1.0
+
+    def test_disabled_section_still_validates(self):
+        sec = health.build_node_health_section(
+            (), None, enabled=False, topk=0, source="")
+        assert sec["enabled"] is False and sec["metrics"] == {}
+
+    def test_report_requires_node_health_key(self):
+        from gossip_sim_tpu.config import Config
+        from gossip_sim_tpu.obs import SpanRegistry
+        from gossip_sim_tpu.obs.report import (REQUIRED_KEYS,
+                                               build_run_report,
+                                               validate_run_report)
+        assert "node_health" in REQUIRED_KEYS
+        rep = build_run_report(Config(), SpanRegistry())
+        assert validate_run_report(rep) == []
+        assert rep["node_health"]["enabled"] is False
+        bad = dict(rep)
+        bad.pop("node_health")
+        assert any("node_health" in p for p in validate_run_report(bad))
+        # a stamped section rides through verbatim
+        reg = SpanRegistry()
+        names, dig, _ = self._digest()
+        reg.set_info("node_health", health.build_node_health_section(
+            names, dig, enabled=True, topk=5, source="engine-traffic"))
+        rep2 = build_run_report(Config(), reg)
+        assert rep2["node_health"]["enabled"] is True
+        assert set(rep2["node_health"]["metrics"]) == set(names)
+
+    def test_influx_point_off_deterministic_wire(self):
+        from gossip_sim_tpu.sinks.influx import DatapointQueue, InfluxDataPoint
+        names, dig, _ = self._digest()
+        vals = health.influx_values(names, dig, topk=5)
+        assert vals["a_total"] == int(dig["deciles"][0].sum())
+        assert "a_hot0_node" in vals and "c_hot4_count" in vals
+        q = DatapointQueue()
+        dp = InfluxDataPoint("123", 4)
+        dp.create_sim_node_health_point(2, vals)
+        dp.create_data_point(1.0, "coverage")
+        q.push_back(dp)
+        raw = dp.data()
+        assert "sim_node_health" in raw and "block=2" in raw
+        lines = q.drain_deterministic_lines()
+        assert lines and all(not ln.startswith("sim_node_health")
+                             for ln in lines)
+
+
+# --------------------------------------------------------------------------
+# kill-and-resume: planes + digests survive a SIGTERM-shaped interrupt
+# --------------------------------------------------------------------------
+
+class TestKillAndResume:
+    def test_all_origins_resume_health_planes_and_digest_bit_exact(
+            self, tmp_path):
+        """An all-origins run killed after its first committed batch and
+        resumed must land on the same node-health stack (journal-sidecar
+        carried) and the identical final digest section as the
+        uninterrupted run."""
+        from gossip_sim_tpu import resilience
+        from gossip_sim_tpu.cli import run_all_origins
+        from gossip_sim_tpu.config import Config
+        from gossip_sim_tpu.engine import clear_compile_cache
+        from gossip_sim_tpu.identity import reset_unique_pubkeys
+        from gossip_sim_tpu.obs import get_registry
+        from gossip_sim_tpu.resilience import journal_path
+        from gossip_sim_tpu.sinks import DatapointQueue
+
+        def cfg(**kw):
+            return Config(num_synthetic_nodes=40, gossip_iterations=5,
+                          warm_up_rounds=2, all_origins=True,
+                          origin_batch=16, seed=9, health=True, **kw)
+
+        def fresh():
+            reset_unique_pubkeys()
+            get_registry().reset()
+            resilience.reset_shutdown()
+            clear_compile_cache()
+
+        def section():
+            return get_registry().snapshot()["info"]["node_health"]
+
+        try:
+            ck_a = str(tmp_path / "full.npz")
+            fresh()
+            s_a = run_all_origins(cfg(checkpoint_path=ck_a), "",
+                                  DatapointQueue(), "0")
+            sec_a = section()
+            assert sec_a["enabled"] and sec_a["source"] == "all-origins"
+
+            ck = str(tmp_path / "ao.npz")
+            fresh()
+            resilience.set_kill_after_units(1)   # after batch 0 of 3
+            with pytest.raises(resilience.ResumableInterrupt):
+                run_all_origins(cfg(checkpoint_path=ck), "",
+                                DatapointQueue(), "0")
+            assert os.path.exists(journal_path(ck))
+
+            fresh()
+            s_c = run_all_origins(cfg(checkpoint_path=ck, resume_path=ck),
+                                  "", DatapointQueue(), "0")
+            sec_c = section()
+        finally:
+            resilience.reset_shutdown()
+
+        assert sec_a == sec_c       # deciles, hot nodes, gini — exact
+        for k in s_a:
+            if k in ("elapsed_s", "origin_iters_per_sec", "stats"):
+                continue
+            assert s_a[k] == s_c[k], k
+        # the sidecar-carried raw stacks themselves agree bit-for-bit
+        with np.load(str(tmp_path / "full.aggstate.npz")) as za, \
+                np.load(str(tmp_path / "ao.aggstate.npz")) as zc:
+            np.testing.assert_array_equal(za["node_health_stack"],
+                                          zc["node_health_stack"])
+
+# --------------------------------------------------------------------------
+# offline tools: trace_report hot-nodes cross-check + health_report
+# --------------------------------------------------------------------------
+
+def _load_tool(name):
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"tools_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTools:
+    def _main(self, extra):
+        from gossip_sim_tpu.cli import main
+        from gossip_sim_tpu.identity import reset_unique_pubkeys
+        from gossip_sim_tpu.obs import get_registry
+        reset_unique_pubkeys()
+        get_registry().reset()
+        return main(["--num-synthetic-nodes", "40", "--seed", "7"] + extra)
+
+    def test_trace_report_hot_nodes_cross_checks_sim_planes(
+            self, tmp_path, capsys):
+        """The trace recount of per-node egress/ingress must equal the
+        engine's accumulator planes in the checkpoint bit-for-bit."""
+        d, ck = str(tmp_path / "tr"), str(tmp_path / "ck.npz")
+        assert self._main(["--iterations", "12", "--warm-up-rounds", "4",
+                           "--packet-loss-rate", "0.1",
+                           "--trace-dir", d, "--checkpoint-path", ck]) == 0
+        trace_report = _load_tool("trace_report")
+        rc = trace_report.main(["hot-nodes", d, "--checkpoint", ck])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cross-check egress: OK" in out
+        assert "cross-check ingress: OK" in out
+
+    def test_trace_report_hot_nodes_cross_checks_traffic_planes(
+            self, tmp_path, capsys):
+        d, ck = str(tmp_path / "tr"), str(tmp_path / "ck.npz")
+        assert self._main(["--iterations", "12", "--warm-up-rounds", "4",
+                           "--traffic-values", "4", "--traffic-rate", "2",
+                           "--node-ingress-cap", "4",
+                           "--node-egress-cap", "6",
+                           "--trace-dir", d, "--checkpoint-path", ck]) == 0
+        trace_report = _load_tool("trace_report")
+        rc = trace_report.main(["hot-nodes", d, "--checkpoint", ck])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cross-check deferred: OK" in out
+        assert "cross-check queue_dropped: OK" in out
+
+    def test_health_report_subcommands_on_real_report(
+            self, tmp_path, capsys):
+        """hot-nodes conserves the stats queue_dropped total exactly,
+        deciles/imbalance render, diff of a report with itself is flat."""
+        import json
+        rep = str(tmp_path / "rep.json")
+        assert self._main(["--iterations", "10", "--warm-up-rounds", "2",
+                           "--traffic-values", "4", "--traffic-rate", "2",
+                           "--node-ingress-cap", "4",
+                           "--node-egress-cap", "6", "--health",
+                           "--run-report", rep]) == 0
+        health_report = _load_tool("health_report")
+        rc = health_report.main(["hot-nodes", rep,
+                                 "--metric", "queue_dropped", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        ent = out["queue_dropped"]
+        assert ent["conserved"] is True
+        assert ent["stats_key"] == "queue_dropped_ingress"
+        assert ent["total"] == ent["stats_value"] > 0
+        assert sum(e["count"] for e in ent["hot_nodes"]) == ent["listed"]
+        # the ranked list is genuinely ranked
+        counts = [e["count"] for e in ent["hot_nodes"]]
+        assert counts == sorted(counts, reverse=True)
+
+        assert health_report.main(["deciles", rep]) == 0
+        assert "mean_latency" in capsys.readouterr().out
+        assert health_report.main(["imbalance", rep, "--json"]) == 0
+        rows = json.loads(capsys.readouterr().out)
+        assert {r["metric"] for r in rows} >= {"queue_dropped", "deferred"}
+
+        assert health_report.main(["diff", rep, rep, "--json"]) == 0
+        d = json.loads(capsys.readouterr().out)
+        assert all(v["total_delta"] == 0 and v["gini_delta"] == 0.0
+                   for v in d.values())
+
+    def test_health_report_rejects_disabled_section(self, tmp_path):
+        rep = str(tmp_path / "rep.json")
+        assert self._main(["--iterations", "4", "--run-report", rep]) == 0
+        health_report = _load_tool("health_report")
+        with pytest.raises(SystemExit, match="disabled"):
+            health_report.main(["hot-nodes", rep])

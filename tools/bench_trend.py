@@ -37,6 +37,7 @@ DEFAULT_METRICS = [
     ("traffic.values_converged_per_sec", True),
     ("adaptive_traffic_steps_per_sec", True),
     ("adaptive_traffic.values_rescued", True),
+    ("health_overhead_pct", False),             # BENCH_r10+ (ISSUE 17)
     ("coverage_mean", True),
     ("capacity.mem_bytes_per_node", False),     # BENCH_r09+ (ISSUE 13)
     ("capacity.peak_rss_bytes", False),

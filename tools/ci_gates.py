@@ -25,10 +25,15 @@ The gates, in dependency-light-first order:
                 run-report capacity section with nonzero cost-harvest +
                 peak-RSS fields, memwatch overhead < 2%, zero bit-impact
                 on parity snapshots and wire lines
+  health_smoke  node-health observatory (ISSUE 17): --health zero
+                bit-impact on parity snapshots and deterministic wire
+                lines, 1k-node engine-vs-oracle health-plane parity
+                under faults, digest decile sums equal cluster
+                aggregates (device == numpy), overhead < 2%
 
 Usage: python tools/ci_gates.py [--only NAME[,NAME...]] [--list]
 
-``--only`` runs a subset (ten serial gates take a while — pick the ones
+``--only`` runs a subset (eleven serial gates take a while — pick the ones
 your change touches); ``--list`` prints the registry and exits.  The
 summary table carries each gate's wall time.
 
@@ -44,7 +49,7 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
          "pull_smoke", "lane_smoke", "resume_smoke", "traffic_smoke",
-         "adaptive_smoke", "capacity_smoke"]
+         "adaptive_smoke", "capacity_smoke", "health_smoke"]
 
 
 def main() -> int:

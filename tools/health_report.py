@@ -1,0 +1,280 @@
+"""Offline analysis of the ``node_health`` run-report section (obs/health.py).
+
+Subcommands over a run-report JSON (schema gossip-sim-tpu/node-health/v1,
+stamped by ``--health`` runs into ``report["node_health"]``):
+
+  hot-nodes REPORT [...]      ranked hot-node attribution per metric: the
+                              top-k list, the fraction of the metric total
+                              it covers, and an exact-conservation check
+                              against the run's stats block where one maps
+  deciles REPORT [...]        stake-decile load table per metric + the
+                              decile coverage-latency table
+  imbalance REPORT [...]      load-imbalance Gini per metric, worst first
+  diff REPORT_A REPORT_B      per-metric total/gini deltas and hot-node
+                              set churn between two reports
+
+Shared flags: ``--metric NAME`` (restrict to one metric; default = all),
+``--json`` (machine-readable output).  ``hot-nodes`` adds ``--top K``
+(truncate the printed list; attribution is computed over what is printed)
+and ``--require-attribution PCT`` (exit 1 unless the ranked list covers at
+least PCT percent of the metric total — the CI/acceptance hook).
+
+Examples:
+
+  python tools/health_report.py hot-nodes report.json --metric queue_dropped
+  python tools/health_report.py hot-nodes report.json \\
+      --metric queue_dropped --require-attribution 90
+  python tools/health_report.py deciles report.json
+  python tools/health_report.py imbalance report.json --json
+  python tools/health_report.py diff base.json loss.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gossip_sim_tpu.obs.health import HEALTH_SCHEMA  # noqa: E402
+
+# health metric -> run-report stats key holding the same conserved count
+# (traffic runs).  The qdrop/defer planes accumulate push AND pull sides,
+# so they map to the *_ingress / *_egress stats, not the push-only ones.
+_STATS_CROSSCHECK = {
+    "queue_dropped": "queue_dropped_ingress",
+    "deferred": "queue_deferred_egress",
+}
+
+
+def _load_section(path: str) -> tuple:
+    """(report, node_health section) or SystemExit with a real reason."""
+    try:
+        with open(path) as f:
+            report = json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"cannot read run report {path}: {e}")
+    sec = report.get("node_health")
+    if not isinstance(sec, dict):
+        raise SystemExit(f"{path}: no node_health section (pre-v8 report?)")
+    schema = sec.get("schema")
+    if schema not in (None, HEALTH_SCHEMA):
+        raise SystemExit(f"{path}: unknown node_health schema {schema!r}")
+    if not sec.get("enabled"):
+        raise SystemExit(f"{path}: node_health disabled — rerun with "
+                         "--health to populate the section")
+    if not sec.get("metrics"):
+        raise SystemExit(f"{path}: node_health enabled but empty")
+    return report, sec
+
+
+def _pick_metrics(sec: dict, metric: str | None) -> dict:
+    metrics = sec["metrics"]
+    if metric is None:
+        return metrics
+    if metric not in metrics:
+        raise SystemExit(f"unknown metric {metric!r} (report has: "
+                         f"{', '.join(sorted(metrics))})")
+    return {metric: metrics[metric]}
+
+
+# --------------------------------------------------------------------------
+# hot-nodes
+# --------------------------------------------------------------------------
+
+def cmd_hot_nodes(args) -> int:
+    report, sec = _load_section(args.report)
+    metrics = _pick_metrics(sec, args.metric)
+    stats = report.get("stats") or {}
+    # traffic runs nest the conserved counters one level down
+    if isinstance(stats.get("traffic"), dict):
+        stats = stats["traffic"]
+    out, rc = {}, 0
+    for name, m in metrics.items():
+        nodes = m["hot_nodes"]
+        if args.top is not None:
+            nodes = nodes[:args.top]
+        listed = sum(int(e["count"]) for e in nodes)
+        total = int(m["total"])
+        frac = listed / total if total else 1.0
+        ent = {
+            "total": total,
+            "listed": listed,
+            "attribution_pct": round(100.0 * frac, 2),
+            "hot_nodes": nodes,
+        }
+        ck = _STATS_CROSSCHECK.get(name)
+        if ck in stats:
+            ent["stats_key"] = ck
+            ent["stats_value"] = int(stats[ck])
+            ent["conserved"] = (int(stats[ck]) == total)
+            if not ent["conserved"]:
+                rc = 1
+        if (args.require_attribution is not None
+                and 100.0 * frac < args.require_attribution):
+            ent["attribution_ok"] = False
+            rc = 1
+        out[name] = ent
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return rc
+    for name, ent in out.items():
+        print(f"{name}: total={ent['total']}  listed {len(ent['hot_nodes'])}"
+              f" nodes cover {ent['listed']}"
+              f" ({ent['attribution_pct']:.2f}%)")
+        if "stats_key" in ent:
+            tag = "OK" if ent["conserved"] else "MISMATCH"
+            print(f"  conservation vs stats.{ent['stats_key']}: {tag} "
+                  f"(section={ent['total']} stats={ent['stats_value']})")
+        if ent.get("attribution_ok") is False:
+            print(f"  attribution below --require-attribution "
+                  f"{args.require_attribution}%")
+        for rank, e in enumerate(ent["hot_nodes"]):
+            share = 100.0 * e["count"] / ent["total"] if ent["total"] else 0.0
+            print(f"  #{rank:<3d} node {e['node']:<6d} count {e['count']:<8d}"
+                  f" {share:6.2f}%")
+    return rc
+
+
+# --------------------------------------------------------------------------
+# deciles
+# --------------------------------------------------------------------------
+
+def cmd_deciles(args) -> int:
+    _, sec = _load_section(args.report)
+    metrics = _pick_metrics(sec, args.metric)
+    out = {name: {"total": int(m["total"]), "deciles": m["deciles"]}
+           for name, m in metrics.items()}
+    lat = sec.get("latency")
+    if lat:
+        out["latency"] = lat
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    print(f"{'metric':<18s} " + " ".join(f"d{i:<7d}" for i in range(10)))
+    for name, m in metrics.items():
+        print(f"{name:<18s} "
+              + " ".join(f"{int(x):<8d}" for x in m["deciles"]))
+    if lat:
+        print("\ndecile coverage-latency (decile 0 = lowest stake):")
+        print(f"{'nodes':<18s} "
+              + " ".join(f"{int(x):<8d}" for x in lat["decile_nodes"]))
+        print(f"{'delivered':<18s} "
+              + " ".join(f"{int(x):<8d}"
+                         for x in lat["delivered_deciles"]))
+        print(f"{'mean_latency':<18s} "
+              + " ".join(f"{float(x):<8.3f}"
+                         for x in lat["mean_latency_deciles"]))
+    return 0
+
+
+# --------------------------------------------------------------------------
+# imbalance
+# --------------------------------------------------------------------------
+
+def cmd_imbalance(args) -> int:
+    _, sec = _load_section(args.report)
+    metrics = _pick_metrics(sec, args.metric)
+    rows = sorted(((name, float(m["gini"]), int(m["total"]))
+                   for name, m in metrics.items()),
+                  key=lambda r: -r[1])
+    if args.json:
+        print(json.dumps([{"metric": n, "gini": g, "total": t}
+                          for n, g, t in rows], indent=2))
+        return 0
+    print(f"{'metric':<18s} {'gini':>8s} {'total':>12s}")
+    for n, g, t in rows:
+        print(f"{n:<18s} {g:>8.4f} {t:>12d}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+# diff
+# --------------------------------------------------------------------------
+
+def cmd_diff(args) -> int:
+    _, sa = _load_section(args.report_a)
+    _, sb = _load_section(args.report_b)
+    names = sorted(set(sa["metrics"]) | set(sb["metrics"]))
+    if args.metric is not None:
+        if args.metric not in names:
+            raise SystemExit(f"unknown metric {args.metric!r}")
+        names = [args.metric]
+    out = {}
+    for name in names:
+        ma, mb = sa["metrics"].get(name), sb["metrics"].get(name)
+        if ma is None or mb is None:
+            out[name] = {"only_in": "B" if ma is None else "A"}
+            continue
+        hot_a = {e["node"] for e in ma["hot_nodes"]}
+        hot_b = {e["node"] for e in mb["hot_nodes"]}
+        out[name] = {
+            "total_a": int(ma["total"]), "total_b": int(mb["total"]),
+            "total_delta": int(mb["total"]) - int(ma["total"]),
+            "gini_a": float(ma["gini"]), "gini_b": float(mb["gini"]),
+            "gini_delta": round(float(mb["gini"]) - float(ma["gini"]), 6),
+            "hot_entered": sorted(hot_b - hot_a),
+            "hot_left": sorted(hot_a - hot_b),
+        }
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    for name, d in out.items():
+        if "only_in" in d:
+            print(f"{name}: only in report {d['only_in']}")
+            continue
+        print(f"{name}: total {d['total_a']} -> {d['total_b']} "
+              f"({d['total_delta']:+d}), gini {d['gini_a']:.4f} -> "
+              f"{d['gini_b']:.4f} ({d['gini_delta']:+.4f})")
+        if d["hot_entered"]:
+            print(f"  hot-set entered: {d['hot_entered']}")
+        if d["hot_left"]:
+            print(f"  hot-set left:    {d['hot_left']}")
+    return 0
+
+
+# --------------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="health_report.py",
+        description="analyze the node_health section of a run report")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("hot-nodes", help="ranked hot-node attribution")
+    p.add_argument("report")
+    p.add_argument("--metric", default=None)
+    p.add_argument("--top", type=int, default=None,
+                   help="truncate the ranked list to K nodes")
+    p.add_argument("--require-attribution", type=float, default=None,
+                   metavar="PCT", help="exit 1 unless the list covers "
+                   "at least PCT%% of the metric total")
+    p.add_argument("--json", action="store_true")
+
+    p = sub.add_parser("deciles", help="stake-decile load + latency table")
+    p.add_argument("report")
+    p.add_argument("--metric", default=None)
+    p.add_argument("--json", action="store_true")
+
+    p = sub.add_parser("imbalance", help="per-metric Gini, worst first")
+    p.add_argument("report")
+    p.add_argument("--metric", default=None)
+    p.add_argument("--json", action="store_true")
+
+    p = sub.add_parser("diff", help="compare two reports' health sections")
+    p.add_argument("report_a")
+    p.add_argument("report_b")
+    p.add_argument("--metric", default=None)
+    p.add_argument("--json", action="store_true")
+
+    args = ap.parse_args(argv)
+    fn = {"hot-nodes": cmd_hot_nodes, "deciles": cmd_deciles,
+          "imbalance": cmd_imbalance, "diff": cmd_diff}[args.cmd]
+    try:
+        return fn(args)
+    except BrokenPipeError:  # pragma: no cover - piping into head
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,206 @@
+"""Node-health observatory smoke test: the CI gate for obs/health.py +
+the engine health planes (ISSUE 17).
+
+Fast CPU gate (~2 min) over four contracts:
+
+  1. **Zero bit-impact**: enabling ``--health`` moves no bit of the
+     stats parity snapshot or the deterministic Influx wire lines, and
+     the ``sim_node_health`` series is excluded from the deterministic
+     wire surface (it carries run-shaped attribution, like sim_perf /
+     sim_capacity).
+  2. **1k-node oracle parity**: every engine health plane (sent / recv /
+     deferred / queue-dropped / prunes both sides / rescued / latency /
+     delivered) matches a loop-based ``TrafficOracle`` recount
+     bit-for-bit on the acceptance regime (1024 nodes, loss + churn +
+     caps tight enough that queue drops actually fire).
+  3. **Digest exactness**: the on-device digest's decile sums equal the
+     cluster-wide aggregates exactly, and the whole digest (deciles,
+     top-k, Gini parts) is bit-identical to the numpy twin on the real
+     planes.
+  4. **Overhead < 2%**: the gated-on engine stays within the overhead
+     budget of the gated-off engine on an A/B wall-clock comparison
+     (absolute slack absorbs CI timer noise on sub-second runs).
+
+Usage: python tools/health_smoke.py [--seed 7] [--reps 2]
+       [--overhead-budget 0.02] [--overhead-slack-s 0.2]
+
+Exit code 0 = all contracts hold; 1 = a health invariant failed.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="node-health observatory smoke (CPU, <2min)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--overhead-budget", type=float, default=0.02)
+    ap.add_argument("--overhead-slack-s", type=float, default=0.2)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from gossip_sim_tpu.cli import run_simulation
+    from gossip_sim_tpu.config import Config
+    from gossip_sim_tpu.engine import make_cluster_tables
+    from gossip_sim_tpu.engine.params import EngineParams
+    from gossip_sim_tpu.engine.traffic import (device_traffic_tables,
+                                               init_traffic_state,
+                                               run_traffic_rounds)
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    from gossip_sim_tpu.obs import health
+    from gossip_sim_tpu.obs.spans import get_registry
+    from gossip_sim_tpu.sinks import DatapointQueue, InfluxDataPoint
+    from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+    from gossip_sim_tpu.traffic import TrafficOracle
+
+    t_start = time.time()
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    def stakes(n):
+        rng = np.random.default_rng(args.seed)
+        return rng.choice(np.arange(1, 50 * n), size=n,
+                          replace=False).astype(np.int64) * 10**6
+
+    # ---- gate 1: zero bit-impact -----------------------------------------
+    print("[1/4] zero bit-impact of --health on the deterministic surface")
+
+    def run_single(health_on: bool):
+        reset_unique_pubkeys()
+        get_registry().reset()
+        cfg = Config(num_synthetic_nodes=200, gossip_iterations=8,
+                     warm_up_rounds=2, seed=args.seed, health=health_on)
+        coll = GossipStatsCollection()
+        coll.set_number_of_simulations(1)
+        dpq = DatapointQueue()
+        run_simulation(cfg, "", coll, dpq, 0, "0", 0.0)
+        return (coll.collection[0].parity_snapshot(),
+                dpq.drain_deterministic_lines())
+
+    snap_off, wire_off = run_single(False)
+    snap_on, wire_on = run_single(True)
+    check(snap_off == snap_on,
+          "--health moves zero bits of the stats parity snapshot")
+    check(wire_off == wire_on,
+          "--health moves zero bits of the deterministic Influx wire lines")
+
+    dpq = DatapointQueue()
+    dp = InfluxDataPoint("0")
+    dp.create_sim_node_health_point(0, {"queue_dropped_total": 12,
+                                        "queue_dropped_gini": 0.4})
+    dpq.push_back(dp)
+    check(dpq.drain_deterministic_lines() == [],
+          "sim_node_health excluded from the deterministic wire surface")
+
+    # ---- gate 2: 1k-node oracle parity -----------------------------------
+    print("[2/4] 1k-node engine-vs-oracle plane parity under faults")
+    plane_to_oracle = {
+        "sent_acc": "node_sent", "recv_acc": "node_recv",
+        "defer_acc": "node_deferred", "qdrop_acc": "node_queue_dropped",
+        "prune_acc": "node_prune_sent",
+        "health_prune_recv": "node_prune_recv",
+        "health_lat_acc": "node_lat_sum",
+        "health_del_acc": "node_delivered",
+        "health_rescued_acc": "node_rescued",
+    }
+    n = 1024
+    rounds = 6
+    params = EngineParams(
+        num_nodes=n, traffic_values=16, traffic_rate=3,
+        node_ingress_cap=24, node_egress_cap=48, traffic_stall_rounds=3,
+        warm_up_rounds=0, probability_of_rotation=0.05, impair_seed=99,
+        packet_loss_rate=0.15, churn_fail_rate=0.03,
+        churn_recover_rate=0.3, min_num_upserts=5, health=True).validate()
+    sk = stakes(n)
+    tables = make_cluster_tables(sk)
+    tt = device_traffic_tables(sk)
+    st = init_traffic_state(sk, params, args.seed)
+    st, _ = run_traffic_rounds(params, tables, tt, st, rounds)
+
+    orc = TrafficOracle(
+        sk, seed=args.seed, impair_seed=params.impair_seed,
+        traffic_values=params.traffic_values,
+        traffic_rate=params.traffic_rate,
+        node_ingress_cap=params.node_ingress_cap,
+        node_egress_cap=params.node_egress_cap,
+        traffic_stall_rounds=params.traffic_stall_rounds,
+        push_fanout=params.push_fanout,
+        active_set_size=params.active_set_size,
+        min_num_upserts=params.min_num_upserts,
+        probability_of_rotation=params.probability_of_rotation,
+        packet_loss_rate=params.packet_loss_rate,
+        churn_fail_rate=params.churn_fail_rate,
+        churn_recover_rate=params.churn_recover_rate)
+    acc = {f: np.zeros(n, np.int64) for f in plane_to_oracle}
+    for it in range(rounds):
+        tr = orc.run_round(it)
+        for plane, fld in plane_to_oracle.items():
+            acc[plane] += getattr(tr, fld)
+    for plane in plane_to_oracle:
+        check(np.array_equal(np.asarray(getattr(st, plane)), acc[plane]),
+              f"plane {plane} bit-equal to oracle recount")
+    check(acc["qdrop_acc"].sum() > 0,
+          f"regime exercises queue drops ({acc['qdrop_acc'].sum()} drops)")
+
+    # ---- gate 3: digest exactness ----------------------------------------
+    print("[3/4] digest: decile sums equal aggregates, device == numpy")
+    ids = health.stake_decile_ids(sk)
+    stack = np.stack([np.asarray(getattr(st, p), np.int64)
+                      for p in plane_to_oracle])
+    dv = health.digest_stack(stack, ids, 10)
+    nv = health.digest_stack_np(stack, ids, 10)
+    for key in nv:
+        check(np.array_equal(dv[key], nv[key]),
+              f"digest[{key}] device == numpy twin")
+    check(np.array_equal(dv["deciles"].sum(axis=1), stack.sum(axis=1)),
+          "decile sums equal the cluster-wide aggregates exactly")
+
+    # ---- gate 4: health overhead < budget --------------------------------
+    print("[4/4] health overhead within budget (A/B wall clock)")
+
+    def timed_run(health_on: bool):
+        reset_unique_pubkeys()
+        get_registry().reset()
+        cfg = Config(num_synthetic_nodes=400, gossip_iterations=16,
+                     warm_up_rounds=4, seed=args.seed, health=health_on)
+        coll = GossipStatsCollection()
+        coll.set_number_of_simulations(1)
+        t0 = time.perf_counter()
+        run_simulation(cfg, "", coll, DatapointQueue(), 0, "0", 0.0)
+        return time.perf_counter() - t0
+
+    timed_run(False)  # cold: warm the jit cache shapes
+    timed_run(True)
+    t_off = min(timed_run(False) for _ in range(max(1, args.reps)))
+    t_on = min(timed_run(True) for _ in range(max(1, args.reps)))
+    overhead = (t_on - t_off) / t_off if t_off > 0 else 0.0
+    budget = t_off * (1.0 + args.overhead_budget) + args.overhead_slack_s
+    print(f"  off={t_off:.3f}s on={t_on:.3f}s "
+          f"wall delta={overhead * 100:+.2f}%")
+    check(t_on <= budget,
+          f"health overhead within {args.overhead_budget:.0%} "
+          f"+ {args.overhead_slack_s}s timer-noise slack")
+
+    print(f"  elapsed: {time.time() - t_start:.1f}s")
+    if failures:
+        print(f"HEALTH SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("HEALTH SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

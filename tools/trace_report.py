@@ -8,6 +8,9 @@ v1 traces load too — they just carry no pull arrays):
   explain-stranded DIR [...]    root-cause every stranded node of a round
   attribute-rmr DIR [--top K]   top-K redundant edges behind the RMR
   diff DIR_A DIR_B [...]        edge-by-edge delivered-set diff of two traces
+  hot-nodes DIR [...]           recompute per-node egress/ingress/drop counts
+                                from the trace; --checkpoint cross-checks
+                                them against the engine's accumulator planes
 
 Shared flags: ``--round R`` (absolute round index; default = last traced),
 ``--col C`` (origin column for multi-origin traces; default 0), ``--json``
@@ -20,6 +23,7 @@ Examples:
   python tools/trace_report.py explain-stranded /tmp/trace --json
   python tools/trace_report.py attribute-rmr /tmp/trace --top 10
   python tools/trace_report.py diff /tmp/base /tmp/loss --top 5
+  python tools/trace_report.py hot-nodes /tmp/trace --checkpoint run.npz
 """
 
 import argparse
@@ -304,6 +308,137 @@ def cmd_diff(args) -> int:
 
 
 # --------------------------------------------------------------------------
+# hot-nodes
+# --------------------------------------------------------------------------
+
+def _recount_planes(tr) -> dict:
+    """Recompute per-node load planes from the trace's slot outcomes, over
+    the measured (post-warm-up) traced rounds — the independent evidence
+    the node-health observatory's accumulators must agree with."""
+    from gossip_sim_tpu.obs.trace import TRACE_DROPPED
+    from gossip_sim_tpu.traffic import (TRAFFIC_DEFERRED,
+                                        TRAFFIC_QUEUE_DROPPED)
+    m = tr.manifest
+    warm = int(m.get("warm_up_rounds", 0))
+    n = tr.num_nodes
+    measured = [t for t in range(len(tr)) if int(tr.rounds[t]) >= warm]
+    is_traffic = int(m.get("traffic_slots") or 0) > 0
+    if is_traffic:
+        planes = {k: np.zeros(n, np.int64)
+                  for k in ("deferred", "queue_dropped")}
+        for t in measured:
+            code = tr.arrays["code"][t]       # [V, N, F]
+            peers = tr.arrays["peers"][t]
+            # sender-side: egress-cap deferrals accrue to the source row
+            planes["deferred"] += (code == TRAFFIC_DEFERRED).sum(
+                axis=(0, 2)).astype(np.int64)
+            # receiver-side: ingress-cap drops accrue to the target
+            v, src, slot = np.nonzero(code == TRAFFIC_QUEUE_DROPPED)
+            np.add.at(planes["queue_dropped"], peers[v, src, slot], 1)
+    else:
+        planes = {k: np.zeros(n, np.int64)
+                  for k in ("egress", "ingress", "loss_dropped")}
+        for t in measured:
+            for col in range(len(tr.origins)):
+                code = tr.arrays["code"][t, col]      # [N, F]
+                peers = tr.arrays["peers"][t, col]
+                dist = tr.arrays["dist"][t, col]
+                dm = E.delivered_mask(code, dist)
+                planes["egress"] += dm.sum(axis=-1).astype(np.int64)
+                src, slot = np.nonzero(dm)
+                np.add.at(planes["ingress"], peers[src, slot], 1)
+                planes["loss_dropped"] += (
+                    (code == TRACE_DROPPED) & (dist >= 0)[:, None]).sum(
+                    axis=-1).astype(np.int64)
+    return planes
+
+
+#: trace-recomputed plane -> checkpoint SimState / TrafficState array
+_PLANE_TO_CKPT = {
+    "egress": "state.egress_acc", "ingress": "state.ingress_acc",
+    "deferred": "state.defer_acc", "queue_dropped": "state.qdrop_acc",
+}
+
+
+def cmd_hot_nodes(args) -> int:
+    tr = load_trace(args.trace_dir)
+    m = tr.manifest
+    warm, iters = int(m.get("warm_up_rounds", 0)), int(m["iterations"])
+    planes = _recount_planes(tr)
+    traced = set(int(r) for r in tr.rounds)
+    complete = set(range(warm, iters)) <= traced
+    out = {"num_nodes": tr.num_nodes, "complete_coverage": complete,
+           "planes": {}}
+    for name, plane in planes.items():
+        order = np.lexsort((np.arange(len(plane)), -plane))[:args.top]
+        out["planes"][name] = {
+            "total": int(plane.sum()),
+            "hot_nodes": [{"node": int(i), "count": int(plane[i])}
+                          for i in order],
+        }
+    rc = 0
+    if args.checkpoint:
+        # cross-check: the engine's own accumulator planes (carried in
+        # every sim/traffic checkpoint) must equal the trace recount
+        # exactly — possible only when the trace covers every measured
+        # round (and, for sim traces, every origin of the run)
+        if not complete:
+            raise SystemExit(
+                f"ERROR: trace covers {len(traced)} round(s) but the run "
+                f"measured rounds {warm}..{iters - 1}; a partial trace "
+                f"cannot be cross-checked exactly against the engine's "
+                f"cumulative planes")
+        with np.load(args.checkpoint) as z:
+            arrays = {k: z[k] for k in z.files if k.startswith("state.")}
+        is_traffic = int(m.get("traffic_slots") or 0) > 0
+        if not is_traffic:
+            o_ck = arrays["state.egress_acc"].shape[0]
+            if o_ck != len(tr.origins):
+                raise SystemExit(
+                    f"ERROR: checkpoint holds {o_ck} origin plane(s) but "
+                    f"the trace records {len(tr.origins)} origin "
+                    f"column(s); the cumulative counts are not comparable")
+        out["cross_check"] = {}
+        for name, key in _PLANE_TO_CKPT.items():
+            if name not in planes or key not in arrays:
+                continue
+            ck = np.asarray(arrays[key], np.int64)
+            if ck.ndim > 1:               # sim planes are [O, N]
+                ck = ck.sum(axis=0)
+            match = bool(np.array_equal(planes[name], ck))
+            out["cross_check"][name] = {
+                "match": match, "trace_total": int(planes[name].sum()),
+                "checkpoint_total": int(ck.sum()),
+            }
+            if not match:
+                bad = np.nonzero(planes[name] != ck)[0]
+                out["cross_check"][name]["first_mismatches"] = [
+                    {"node": int(i), "trace": int(planes[name][i]),
+                     "checkpoint": int(ck[i])} for i in bad[:5]]
+                rc = 1
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return rc
+    print(f"hot nodes: {len(traced)} traced round(s), "
+          f"{'complete' if complete else 'PARTIAL'} measured-round "
+          f"coverage")
+    for name, ent in out["planes"].items():
+        print(f"  {name}: total={ent['total']}")
+        for h in ent["hot_nodes"]:
+            if h["count"] == 0:
+                break
+            print(f"    node {h['node']}: {h['count']}")
+    for name, ent in out.get("cross_check", {}).items():
+        status = "OK" if ent["match"] else "MISMATCH"
+        print(f"  cross-check {name}: {status} (trace={ent['trace_total']} "
+              f"checkpoint={ent['checkpoint_total']})")
+        for mm in ent.get("first_mismatches", []):
+            print(f"    node {mm['node']}: trace={mm['trace']} "
+                  f"checkpoint={mm['checkpoint']}")
+    return rc
+
+
+# --------------------------------------------------------------------------
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
@@ -341,6 +476,15 @@ def main(argv=None) -> int:
     p = sub.add_parser("diff", help="edge-by-edge diff of two traces")
     common(p, b_dir=True)
     p.add_argument("--top", type=int, default=10)
+    p = sub.add_parser(
+        "hot-nodes",
+        help="recompute per-node load planes; cross-check vs a checkpoint")
+    common(p)
+    p.add_argument("--top", type=int, default=10)
+    p.add_argument("--checkpoint", default=None,
+                   help="checkpoint .npz of the same run: assert the "
+                        "engine's accumulator planes equal the trace "
+                        "recount bit-for-bit")
 
     args = ap.parse_args(argv)
     try:
@@ -350,6 +494,7 @@ def main(argv=None) -> int:
             "explain-stranded": cmd_explain_stranded,
             "attribute-rmr": cmd_attribute_rmr,
             "diff": cmd_diff,
+            "hot-nodes": cmd_hot_nodes,
         }[args.cmd](args)
     except BrokenPipeError:    # output piped into head/less and closed
         return 0
