@@ -554,6 +554,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "retry exhaustion or queue overflow are appended "
                         "to PATH as line protocol instead of discarded; "
                         "re-send with tools/influx_replay.py")
+    p.add_argument("--telemetry-port", type=int, default=-1, metavar="PORT",
+                   help="live telemetry plane (obs/exporter.py): serve "
+                        "/metrics (Prometheus text), /status (the evolving "
+                        "run report as JSON) and /events (recent "
+                        "structured events) on 127.0.0.1:PORT while the "
+                        "run is live. 0 binds an ephemeral port (stamped "
+                        "into the log, registry info and the run report's "
+                        "telemetry section); omit to keep the exporter "
+                        "off. Watch with tools/telemetry_watch.py")
+    p.add_argument("--event-log", default="", metavar="PATH",
+                   help="structured event log (obs/telemetry.py, schema "
+                        "gossip-sim-tpu/events/v1): append heartbeat "
+                        "ticks, journal commits/resumes, watchdog retries/"
+                        "CPU fallbacks, SIGTERM/SIGINT, and Influx retry/"
+                        "spool/drop events to PATH as JSONL. Records carry "
+                        "the run-key fingerprint + unit id, so they join "
+                        "the resilience journal's committed units; append "
+                        "mode makes one PATH span an interrupted-and-"
+                        "resumed run")
     return p
 
 
@@ -656,6 +675,8 @@ def config_from_args(args) -> Config:
         trace_origins=args.trace_origins,
         trace_prune_cap=args.trace_prune_cap,
         compilation_cache_dir=args.compilation_cache_dir,
+        telemetry_port=args.telemetry_port,
+        event_log=args.event_log,
     )
 
 
@@ -1186,6 +1207,8 @@ def run_origin_rank_sweep(config: Config, json_rpc_url: str, origin_ranks,
 
     from .engine import init_state, make_cluster_tables, run_rounds
 
+    get_registry().set_info("run_path", "origin-rank-sweep")
+
     # Journal + state checkpoint (resilience.py; lifts the old "not
     # supported by the batched origin-rank sweep" warning): one unit per
     # measured harvest block.  A unit commits every origin column's
@@ -1426,6 +1449,8 @@ def run_lane_sweep(config: Config, json_rpc_url: str, origin_ranks,
     tests/test_cli.py does for the origin-rank batch."""
     import jax
     import jax.numpy as jnp
+
+    get_registry().set_info("run_path", "lane-sweep")
 
     from .engine import (broadcast_state, check_lane_knobs, init_state,
                          lane_state, make_cluster_tables, merge_lane_statics,
@@ -1721,6 +1746,7 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
                          run_rounds)
     from .stats.aggregate import AllOriginsStats
 
+    get_registry().set_info("run_path", "all-origins")
     # Journal (resilience.py): one unit per origin batch; the aggregate
     # accumulators snapshot into an .aggstate.npz sidecar at each commit,
     # so resume reloads them and re-dispatches only uncommitted batches.
@@ -3139,6 +3165,7 @@ def _run_traffic_lane_sweep(config, point_cfgs, accounts, collection,
     from .stats.traffic import TrafficStats
 
     reg = get_registry()
+    reg.set_info("run_path", "traffic-lane-sweep")
     _enable_compilation_cache(config)
     index = NodeIndex.from_stakes(accounts)
     stakes_np = index.stakes.astype(np.int64)
@@ -3218,6 +3245,9 @@ def run_traffic(config: Config, json_rpc_url: str, dp_queue, start_ts: str,
     tools/traffic_smoke.py)."""
     from .stats.traffic import TrafficStats, TrafficStatsCollection
 
+    get_registry().set_info(
+        "run_path", "traffic-oracle" if config.backend == "oracle"
+        else "traffic")
     is_sweep = (config.test_type in TRAFFIC_SWEEP_TYPES
                 and config.num_simulations > 1)
     n_points = config.num_simulations if is_sweep else 1
@@ -3454,6 +3484,10 @@ def dispatch_sweeps(config: Config, json_rpc_url: str, origin_ranks,
             return
         log.warning("WARNING: --sweep-lanes %s ignored (%s); running the "
                     "serial sweep", config.sweep_lanes, blocker)
+    get_registry().set_info(
+        "run_path",
+        ("serial-sweep" if config.num_simulations > 1 else
+         "single-oracle" if config.backend == "oracle" else "single"))
     # Serial sweep: with --checkpoint-path each completed sim is one
     # journal unit; --resume replays committed sims into stats/Influx
     # verbatim and restarts at the first uncommitted one (resilience.py).
@@ -3531,11 +3565,30 @@ def main(argv=None) -> int:
     # bit-invisible to the simulation (tools/capacity_smoke.py).
     from .obs import capacity as _capacity
     from .obs import memwatch as _memwatch
+    from .obs import telemetry as _telemetry
     _capacity.reset_harvests()
     _capacity.set_harvest_enabled(config.capacity_harvest)
     _memwatch.reset()
     if config.memwatch_interval_s > 0:
         _memwatch.start(config.memwatch_interval_s)
+    # live telemetry plane (obs/telemetry.py + obs/exporter.py, ISSUE 18):
+    # same one-process-one-run reset; the structured event log opens in
+    # append mode so one path spans an interrupted-and-resumed run.  The
+    # baseline run-key fingerprint covers unjournaled runs; _open_journal
+    # re-stamps it with the journal's own key (the join contract).
+    _telemetry.reset()
+    if config.event_log:
+        try:
+            _telemetry.get_hub().open_event_log(config.event_log)
+        except OSError as e:
+            log.error("ERROR: --event-log %s unwritable: %s",
+                      config.event_log, e)
+            return 1
+    _telemetry.get_hub().set_run_key(
+        run_key_from_config(config, kind="run"))
+    _telemetry.emit_event("run_start", pid=os.getpid(),
+                          argv=list(argv) if argv is not None
+                          else sys.argv[1:])
     origin_ranks = args.origin_rank
     if any(r < 1 for r in origin_ranks):
         log.error("ERROR: --origin-rank values must be >= 1 (1 = highest "
@@ -3674,7 +3727,6 @@ def main(argv=None) -> int:
     dp_queue = None
     influx_thread = None
     if args.influx in ("l", "i"):
-        import os
         dp_queue = DatapointQueue()
         load_dotenv()
         try:
@@ -3688,6 +3740,47 @@ def main(argv=None) -> int:
             get_influx_url(args.influx), username, password, database,
             dp_queue, spool_path=config.influx_spool)
 
+    # live Influx sender stats through the hub (ISSUE 18): mid-run scrapes
+    # see points_sent/retries/spooled_points advance instead of waiting
+    # for the end-of-run drain summary
+    if influx_thread is not None:
+        def _live_influx_stats(thread=influx_thread, q=dp_queue):
+            stats = thread.sender_stats()
+            stats["queue_depth"] = len(q)
+            return stats
+        _telemetry.get_hub().set_provider("influx", _live_influx_stats)
+
+    telemetry_server = None
+    if config.telemetry_port >= 0:
+        from .obs.exporter import TelemetryServer
+        from .obs.report import build_run_report
+
+        def _live_status():
+            # the evolving run report, assembled live on each scrape —
+            # the same document --run-report writes at exit
+            influx_live = None
+            if influx_thread is not None:
+                influx_live = _live_influx_stats()
+            return build_run_report(config, get_registry(),
+                                    influx=influx_live)
+        telemetry_server = TelemetryServer(port=config.telemetry_port,
+                                           status_fn=_live_status)
+        try:
+            telemetry_server.start()
+        except OSError as e:
+            log.error("ERROR: --telemetry-port %s unbindable: %s",
+                      config.telemetry_port, e)
+            return 1
+
+    def _finish_telemetry(rc: int) -> int:
+        """Seal the telemetry plane on every run-section exit: emit the
+        run_end event, stop the exporter, close the event log."""
+        _telemetry.emit_event("run_end", rc=int(rc))
+        if telemetry_server is not None:
+            telemetry_server.stop()
+        _telemetry.get_hub().close_event_log()
+        return rc
+
     collection = None
     traffic_summary = None
     try:
@@ -3698,7 +3791,7 @@ def main(argv=None) -> int:
             elif config.all_origins:
                 if config.backend != "tpu":
                     log.error("--all-origins requires --backend tpu")
-                    return 1
+                    return _finish_telemetry(1)
                 if dp_queue is not None:
                     log.info("all-origins: emitting run-level aggregate "
                              "Influx series (per-iteration series are a "
@@ -3715,6 +3808,8 @@ def main(argv=None) -> int:
         # stamp a (partial) run report, and exit with the distinct
         # resumable code so a wrapper can loop on --resume
         log.warning("run interrupted resumably: %s", e)
+        _telemetry.emit_event("resumable_exit",
+                              reason=f"{type(e).__name__}: {e}"[:200])
         influx_stats = _drain_influx(dp_queue, influx_thread,
                                      start_ts, emit_capacity=True)
         stats = faults = None
@@ -3726,14 +3821,14 @@ def main(argv=None) -> int:
         log.warning("exiting with resumable code %s%s", RESUMABLE_EXIT_CODE,
                     f"; resume with --resume {ckpt}" if ckpt else
                     " (no --checkpoint-path: a re-run starts from scratch)")
-        return RESUMABLE_EXIT_CODE
+        return _finish_telemetry(RESUMABLE_EXIT_CODE)
 
     if config.traffic_on:
         influx_stats = _drain_influx(dp_queue, influx_thread,
                                      start_ts, emit_capacity=True)
         _write_run_report(config, stats=traffic_summary,
                           influx=influx_stats)
-        return 0
+        return _finish_telemetry(0)
 
     if config.all_origins:
         influx_stats = _drain_influx(dp_queue, influx_thread,
@@ -3769,7 +3864,7 @@ def main(argv=None) -> int:
             }
         _write_run_report(config, stats=stats, faults=faults,
                           influx=influx_stats)
-        return 0
+        return _finish_telemetry(0)
 
     influx_stats = _drain_influx(dp_queue, influx_thread, start_ts,
                                  emit_capacity=True)
@@ -3787,7 +3882,7 @@ def main(argv=None) -> int:
     log.info("############################################")
     log.info("##### START_TIME: %s ######", start_ts)
     log.info("############################################")
-    return 0
+    return _finish_telemetry(0)
 
 
 if __name__ == "__main__":

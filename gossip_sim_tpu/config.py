@@ -224,6 +224,15 @@ class Config:
                                     # executables are reused across
                                     # processes/CI runs; "" falls back to
                                     # $GOSSIP_COMPILATION_CACHE, unset = off
+    telemetry_port: int = -1        # live telemetry plane (obs/exporter.py):
+                                    # serve /metrics + /status + /events on
+                                    # 127.0.0.1:PORT while the run is live;
+                                    # 0 = ephemeral port (stamped into the
+                                    # log + run report), -1 = off
+    event_log: str = ""             # structured event log (obs/telemetry.py,
+                                    # schema gossip-sim-tpu/events/v1):
+                                    # append heartbeat/journal/watchdog/
+                                    # Influx/signal events as JSONL here
 
     def stepped(self, **kw) -> "Config":
         return replace(self, **kw)

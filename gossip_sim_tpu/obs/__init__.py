@@ -16,11 +16,18 @@ from .report import (PER_CHIP_TARGET, RUN_REPORT_SCHEMA, bench_summary,
                      build_run_report, environment_info, validate_run_report,
                      write_run_report)
 from .spans import SpanRegistry, get_registry, span
+from .telemetry import (EVENT_SCHEMA, TELEMETRY_SCHEMA, TelemetryHub,
+                        emit_event, get_hub, load_event_log,
+                        run_key_fingerprint, validate_event,
+                        validate_event_log)
 from .trace import (TRACE_SCHEMA, OracleTraceCollector, Trace, TraceWriter,
                     load_trace, validate_trace_dir, validate_trace_manifest)
 
 __all__ = [
     "Heartbeat", "SpanRegistry", "get_registry", "span",
+    "EVENT_SCHEMA", "TELEMETRY_SCHEMA", "TelemetryHub", "emit_event",
+    "get_hub", "load_event_log", "run_key_fingerprint", "validate_event",
+    "validate_event_log",
     "PER_CHIP_TARGET", "RUN_REPORT_SCHEMA", "bench_summary",
     "build_run_report", "environment_info", "validate_run_report",
     "write_run_report",

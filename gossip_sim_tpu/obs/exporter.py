@@ -1,0 +1,329 @@
+"""Zero-dependency HTTP exporter for the live telemetry plane (ISSUE 18).
+
+A stdlib-only (``http.server``) background thread behind
+``--telemetry-port`` serving three read-only endpoints off the
+:mod:`gossip_sim_tpu.obs.telemetry` hub:
+
+* ``/metrics`` — Prometheus text exposition (format 0.0.4) of the hub
+  snapshot: span totals, counters, progress/ETA gauges, RSS, live
+  Influx sender stats, event counts.
+* ``/status``  — the evolving run-report as JSON, mid-run (the same
+  ``gossip-sim-tpu/run-report/v1`` document ``--run-report`` writes at
+  exit, assembled live on each scrape).
+* ``/events``  — the most recent structured events (ring buffer; works
+  with or without ``--event-log``).  ``?n=N`` bounds the count.
+
+Port 0 binds an ephemeral port; the bound port is returned from
+:meth:`TelemetryServer.start`, stamped into the log, registry info
+(``telemetry_port``) and the run report's ``telemetry`` section, and
+emitted as a ``telemetry_listen`` event so tools can discover it from
+the event log alone.
+
+The server binds 127.0.0.1 (an introspection surface, not an ingress),
+swallows per-request errors (a scrape must never kill a run), and keeps
+request handling off the simulation thread entirely — the <2% overhead
+contract is enforced by tools/telemetry_smoke.py.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .spans import get_registry
+from .telemetry import get_hub
+
+log = logging.getLogger("gossip_sim_tpu.obs")
+
+#: Prometheus text exposition content type (format 0.0.4)
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_PREFIX = "gossip_sim"
+
+
+def _escape_label(value: str) -> str:
+    """Escape a Prometheus label value (backslash, quote, newline)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _num(value) -> str:
+    """Render a number in exposition format (no inf/nan surprises)."""
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return "0"
+    if f != f:                       # NaN
+        return "NaN"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_text(snap: dict) -> str:
+    """Render a hub snapshot as Prometheus text exposition lines."""
+    lines = []
+
+    def metric(name, mtype, help_text, samples):
+        # samples: list of (label_dict_or_None, value)
+        rendered = []
+        for labels, value in samples:
+            if labels:
+                lab = ",".join(f'{k}="{_escape_label(v)}"'
+                               for k, v in sorted(labels.items()))
+                rendered.append(f"{_PREFIX}_{name}{{{lab}}} {_num(value)}")
+            else:
+                rendered.append(f"{_PREFIX}_{name} {_num(value)}")
+        if rendered:
+            lines.append(f"# HELP {_PREFIX}_{name} {help_text}")
+            lines.append(f"# TYPE {_PREFIX}_{name} {mtype}")
+            lines.extend(rendered)
+
+    run = snap.get("run", {})
+    metric("info", "gauge", "Run identity (constant 1).",
+           [({"platform": run.get("platform", "unknown"),
+              "run_path": run.get("run_path", ""),
+              "fingerprint": run.get("fingerprint", "")}, 1)])
+    metric("wall_seconds", "gauge", "Wall seconds since registry reset.",
+           [(None, run.get("wall_s", 0))])
+    metric("num_nodes", "gauge", "Simulated cluster size.",
+           [(None, run.get("num_nodes", 0))])
+
+    spans = snap.get("spans", {})
+    metric("span_seconds_total", "counter",
+           "Total seconds recorded per span.",
+           [({"span": name}, ent.get("total_s", 0))
+            for name, ent in spans.items()])
+    metric("span_calls_total", "counter", "Span entry count.",
+           [({"span": name}, ent.get("count", 0))
+            for name, ent in spans.items()])
+    metric("counter_total", "counter", "Raw registry counters.",
+           [({"counter": name}, val)
+            for name, val in snap.get("counters", {}).items()])
+
+    progress_samples = {"done": [], "total": [], "pct": [],
+                        "rate": [], "eta_seconds": []}
+    for label, st in snap.get("progress", {}).items():
+        progress_samples["done"].append(({"label": label},
+                                         st.get("done", 0)))
+        progress_samples["total"].append(({"label": label},
+                                          st.get("total", 0)))
+        progress_samples["pct"].append(({"label": label},
+                                        st.get("pct", 0)))
+        progress_samples["rate"].append(({"label": label},
+                                         st.get("rate_per_s", 0)))
+        eta = st.get("eta_s")
+        progress_samples["eta_seconds"].append(
+            ({"label": label}, -1 if eta is None else eta))
+    metric("progress_done", "gauge", "Units completed per loop.",
+           progress_samples["done"])
+    metric("progress_total", "gauge", "Units planned per loop.",
+           progress_samples["total"])
+    metric("progress_pct", "gauge", "Percent complete per loop.",
+           progress_samples["pct"])
+    metric("progress_rate", "gauge", "Units per second per loop.",
+           progress_samples["rate"])
+    metric("progress_eta_seconds", "gauge",
+           "Estimated seconds remaining (-1 = unknown).",
+           progress_samples["eta_seconds"])
+
+    mw = snap.get("memwatch", {})
+    metric("rss_bytes", "gauge", "Current resident set size.",
+           [(None, mw.get("rss_bytes", 0))])
+    metric("peak_rss_bytes", "gauge", "Peak resident set size.",
+           [(None, mw.get("peak_rss_bytes", 0))])
+    metric("peak_device_bytes", "gauge", "Peak device bytes in use.",
+           [(None, mw.get("peak_device_bytes", 0))])
+
+    cap = snap.get("capacity", {})
+    metric("capacity_ledger_bytes", "gauge",
+           "Closed-form donated-buffer ledger total.",
+           [(None, cap.get("ledger_total_bytes", 0))])
+
+    influx = snap.get("influx", {})
+    if influx:
+        metric("influx_points_sent_total", "counter",
+               "Datapoints sent by the Influx sender.",
+               [(None, influx.get("points_sent", 0))])
+        metric("influx_points_dropped_total", "counter",
+               "Datapoints dropped by the Influx sender.",
+               [(None, influx.get("dropped_points", 0))])
+        metric("influx_points_spooled_total", "counter",
+               "Datapoints spooled to disk by the Influx sender.",
+               [(None, influx.get("spooled_points", 0))])
+        metric("influx_retries_total", "counter",
+               "Influx sender POST retries.",
+               [(None, influx.get("retries", 0))])
+        metric("influx_queue_depth", "gauge",
+               "Datapoints waiting in the sender queue.",
+               [(None, influx.get("queue_depth", 0))])
+
+    res = snap.get("resilience", {})
+    metric("journal_committed_units_total", "counter",
+           "Units durably committed to the run journal.",
+           [(None, res.get("committed_units", 0))])
+    metric("journal_resumed_units_total", "counter",
+           "Units replayed from a prior run's journal.",
+           [(None, res.get("resumed_units", 0))])
+    metric("device_failures_total", "counter",
+           "Supervised dispatch failures.",
+           [(None, res.get("device_failures", 0))])
+
+    ev = snap.get("events", {})
+    metric("events_emitted_total", "counter",
+           "Structured events emitted this run.",
+           [(None, ev.get("emitted", 0))])
+
+    return "\n".join(lines) + "\n"
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Parse exposition text back into ``{name: {labelset: value}}``
+    (labelset = the raw ``{...}`` string, '' for bare samples).  Strict
+    enough to be the smoke gate's validity check: every non-comment line
+    must be ``name[{labels}] value`` with a parseable float value and a
+    legal metric name."""
+    import re
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    out: dict = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        body, _, value = line.rpartition(" ")
+        if not body:
+            raise ValueError(f"line {i}: no metric/value split: {line!r}")
+        if "{" in body:
+            name, _, rest = body.partition("{")
+            if not rest.endswith("}"):
+                raise ValueError(f"line {i}: unterminated labels")
+            labels = "{" + rest
+        else:
+            name, labels = body, ""
+        if not name_re.match(name):
+            raise ValueError(f"line {i}: bad metric name {name!r}")
+        out.setdefault(name, {})[labels] = float(value)
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "gossip-sim-telemetry/1"
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        try:
+            url = urlparse(self.path)
+            if url.path == "/metrics":
+                body = prometheus_text(self.server.hub.snapshot())
+                self._reply(200, PROMETHEUS_CONTENT_TYPE,
+                            body.encode("utf-8"))
+            elif url.path == "/status":
+                status = self.server.status()
+                self._reply(200, "application/json",
+                            (json.dumps(status, default=str) + "\n")
+                            .encode("utf-8"))
+            elif url.path == "/events":
+                n = 100
+                q = parse_qs(url.query)
+                if "n" in q:
+                    try:
+                        n = max(0, min(int(q["n"][0]), 100000))
+                    except ValueError:
+                        pass
+                events = self.server.hub.recent_events(n)
+                self._reply(200, "application/json",
+                            (json.dumps({"schema":
+                                         self.server.event_schema,
+                                         "events": events},
+                                        default=str) + "\n")
+                            .encode("utf-8"))
+            elif url.path in ("/", "/healthz"):
+                self._reply(200, "text/plain", b"ok\n")
+            else:
+                self._reply(404, "text/plain", b"not found\n")
+        except Exception as e:  # pragma: no cover - scrape never kills run
+            try:
+                self._reply(500, "text/plain",
+                            f"telemetry error: {e}\n".encode("utf-8"))
+            except Exception:
+                pass
+
+    def _reply(self, code: int, ctype: str, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        get_registry().add("telemetry/scrapes")
+
+    def log_message(self, fmt, *args):  # quiet: requests go to debug
+        log.debug("telemetry http: " + fmt, *args)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # ephemeral-port churn in tests: reuse addresses aggressively
+    allow_reuse_address = True
+
+
+class TelemetryServer:
+    """The background HTTP exporter.  ``start()`` binds and returns the
+    port; ``stop()`` shuts the serve loop down and joins the thread."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 status_fn=None, hub=None):
+        self.requested_port = int(port)
+        self.host = host
+        self.hub = hub if hub is not None else get_hub()
+        self._status_fn = status_fn
+        self._httpd = None
+        self._thread = None
+        self.port = 0
+
+    def _status(self) -> dict:
+        if self._status_fn is None:
+            return self.hub.snapshot()
+        try:
+            return self._status_fn()
+        except Exception as e:  # pragma: no cover - scrape never kills run
+            return {"error": f"status assembly failed: {e}"}
+
+    def start(self) -> int:
+        if self._httpd is not None:
+            return self.port
+        from .telemetry import EVENT_SCHEMA
+        httpd = _Server((self.host, self.requested_port), _Handler)
+        httpd.hub = self.hub
+        httpd.status = self._status
+        httpd.event_schema = EVENT_SCHEMA
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        # a tight poll keeps stop() latency ~50ms worst-case — teardown
+        # is on the run's critical path and counts against the <2%
+        # overhead budget on short runs (the idle select() is free)
+        self._thread = threading.Thread(target=httpd.serve_forever,
+                                        kwargs={"poll_interval": 0.05},
+                                        daemon=True, name="telemetry-http")
+        self._thread.start()
+        log.info("telemetry: serving /metrics /status /events on "
+                 "http://%s:%d", self.host, self.port)
+        self.hub.emit("telemetry_listen", port=self.port, host=self.host)
+        get_registry().set_info("telemetry_port", self.port)
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        finally:
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+            self._httpd = None
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None

@@ -5,12 +5,22 @@ for minutes with no output between Influx drains; the heartbeat gives the
 operator a cheap periodic "N/M done, X/s, ETA H:MM:SS" line without any
 per-unit logging cost — ``beat()`` is a monotonic-clock compare unless the
 interval elapsed.
+
+Live telemetry (ISSUE 18): every ``beat()`` call — including the
+log-suppressed ones — publishes its structured :meth:`state` to the
+telemetry hub, so ``/metrics`` and ``/status`` always carry fresh
+progress at unit granularity; every *logged* tick is additionally
+emitted as a structured ``heartbeat`` event (machine-readable progress
+for daemonized/redirected runs), with the same zero-step/overshoot ETA
+hardening in the payload as in the log line.
 """
 
 from __future__ import annotations
 
 import logging
 import time
+
+from . import telemetry
 
 log = logging.getLogger("gossip_sim_tpu.obs")
 
@@ -46,6 +56,41 @@ class Heartbeat:
         knows exactly how much a preemption would preserve."""
         self.committed = max(0, int(committed_units))
 
+    def state(self, done: int, now: float | None = None) -> dict:
+        """Structured progress payload (the event/hub counterpart of the
+        log line), hardened for the same degenerate ticks as
+        :meth:`_format`: ``done`` is clamped into [0, total] (the raw
+        value survives as ``raw_done`` so an overshooting caller is
+        visible, not hidden); zero completed steps or a zero-elapsed
+        first tick report rate 0 and ``eta_s: None`` (the log's "?");
+        a finished loop reports ``eta_s: 0`` even when the rate is
+        unmeasurable."""
+        if now is None:
+            now = time.monotonic()
+        raw = int(done)
+        done = max(0, min(raw, self.total) if self.total else raw)
+        elapsed = max(0.0, now - self._t0)
+        pct = 100.0 * done / self.total if self.total else 0.0
+        rate = done / elapsed if elapsed > 0 else 0.0
+        if self.total and done >= self.total:
+            eta_s = 0.0
+        elif rate > 0 and self.total:
+            eta_s = round(max(0.0, (self.total - done) / rate), 3)
+        else:
+            eta_s = None
+        return {
+            "label": self.label,
+            "unit": self.unit,
+            "done": done,
+            "raw_done": raw,
+            "total": self.total,
+            "pct": round(pct, 3),
+            "rate_per_s": round(rate, 4),
+            "elapsed_s": round(elapsed, 3),
+            "eta_s": eta_s,
+            "committed": self.committed,
+        }
+
     def _format(self, done: int, now: float) -> str:
         # Hardened for the degenerate ticks (ISSUE 3): done < 0 or beyond
         # total is clamped; zero completed steps (or a zero-elapsed first
@@ -72,14 +117,26 @@ class Heartbeat:
 
     def beat(self, done_units: int, force: bool = False) -> str | None:
         """Log progress if ``interval_s`` elapsed since the last beat (or
-        ``force``).  Returns the logged message, or None if suppressed."""
+        ``force``).  Returns the logged message, or None if suppressed.
+
+        Every call (suppressed or not) refreshes the telemetry hub's
+        progress slot for this label; logged ticks also emit a
+        ``heartbeat`` structured event.
+        """
         now = time.monotonic()
+        state = self.state(done_units, now)
+        telemetry.get_hub().note_progress(self.label, state)
         if not force and now - self._last < self.interval_s:
             return None
         msg = self._format(done_units, now)
         self._log.info("%s", msg)
         self._last = now
         self.beats_logged += 1
+        # "unit" in an event record is the journal unit id (an int);
+        # the heartbeat's unit *name* travels as unit_name
+        payload = dict(state)
+        payload["unit_name"] = payload.pop("unit")
+        telemetry.emit_event("heartbeat", **payload)
         return msg
 
     def finish(self) -> str:

@@ -112,6 +112,7 @@ REQUIRED_KEYS = {
     "resilience": dict,
     "capacity": dict,
     "node_health": dict,
+    "telemetry": dict,
 }
 
 
@@ -257,8 +258,29 @@ def build_run_report(config, registry, *, stats: dict | None = None,
             "fallback_units":
                 int(registry.counter("resilience/fallback_units")),
         },
+        "telemetry": _telemetry_section(info, registry),
     })
     return report
+
+
+def _telemetry_section(info: dict, registry) -> dict:
+    """Live telemetry plane accounting (obs/telemetry.py + exporter;
+    ISSUE 18): the bound exporter port (0 = exporter never started),
+    the event-log path, events emitted and HTTP scrapes served.  Present
+    (all-zero) on every report so the schema stays fixed."""
+    try:
+        from . import telemetry
+        hub = telemetry.get_hub()
+        return {
+            "port": int(info.get("telemetry_port", 0) or 0),
+            "event_log": hub.event_log_path,
+            "events_emitted": int(hub.events_emitted()),
+            "run_fingerprint": hub.run_fingerprint(),
+            "scrapes": int(registry.counter("telemetry/scrapes")),
+        }
+    except Exception:  # pragma: no cover - report must never kill a run
+        return {"port": 0, "event_log": "", "events_emitted": 0,
+                "run_fingerprint": "", "scrapes": 0}
 
 
 def _capacity_section(info: dict) -> dict:

@@ -1,0 +1,417 @@
+"""Live telemetry plane: the hub + the structured event log (ISSUE 18).
+
+Every observability surface before this one — spans/run-reports, traces,
+capacity, node health — is *post-hoc*: harvested once, at exit.  The hub
+turns those same registries into a surface that is inspectable **while
+the run is alive**:
+
+* :class:`TelemetryHub` composes one consistent point-in-time snapshot
+  from the span registry (timers/counters/info), heartbeat progress +
+  ETA, the memwatch RSS series and peaks, the capacity ledger, health
+  digests, live Influx sender stats (via a provider callback registered
+  by the CLI once the sender thread exists), and the resilience journal
+  commit counters.  `obs/exporter.py` serves this snapshot over HTTP.
+* The **structured event log** (``--event-log``, schema
+  ``gossip-sim-tpu/events/v1``, JSONL) unifies the scattered free-text
+  signals — heartbeat ticks, watchdog retries/CPU-fallbacks, journal
+  commits, SIGTERM/SIGINT, Influx retry/spool, sweep/lane/batch
+  boundaries — into versioned records.  Each record carries the run-key
+  fingerprint and (where applicable) the unit id, so events join 1:1
+  against the resilience journal's committed units.
+
+Contracts (the standing observability discipline):
+
+* **JAX-free** — importing this module never touches an accelerator.
+* **never kills a run** — emit/snapshot failures are swallowed; a
+  telemetry bug must not take down a multi-hour sweep.
+* **zero bit-impact** — the hub only *reads* simulation state; it never
+  feeds the stats layer or the deterministic Influx wire surface.
+* **reentrant-safe** — the hub lock is an RLock because events can be
+  emitted from signal handlers interrupting an in-progress emit.
+
+One process == one run: :func:`reset` joins the registry/memwatch/
+capacity reset block at the top of ``cli.main``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+
+from .spans import get_registry
+
+log = logging.getLogger("gossip_sim_tpu.obs")
+
+#: schema tag carried by every event record (JSONL event log + /events)
+EVENT_SCHEMA = "gossip-sim-tpu/events/v1"
+
+#: schema tag carried by every hub snapshot (/metrics + tests)
+TELEMETRY_SCHEMA = "gossip-sim-tpu/telemetry/v1"
+
+#: event types the v1 schema admits (validation is a closed-world check
+#: so a typo'd emit site fails the smoke gate instead of shipping junk)
+EVENT_TYPES = frozenset({
+    "run_start",          # process entered a run path (argv, pid)
+    "run_end",            # run finished (rc)
+    "telemetry_listen",   # exporter bound its port (port)
+    "heartbeat",          # a logged progress tick (done/total/rate/eta_s)
+    "unit_done",          # sweep/lane/batch boundary (unit)
+    "journal_commit",     # a unit durably committed (unit)
+    "journal_resume",     # a prior journal replayed (units)
+    "shutdown_signal",    # SIGTERM/SIGINT observed (signum)
+    "resumable_exit",     # run exiting with the resumable code
+    "device_retry",       # watchdog retrying a failed dispatch (attempt)
+    "device_fallback",    # watchdog re-executing a unit on CPU
+    "influx_retry",       # sender POST retry (attempt)
+    "influx_spool",       # sender spooled points to disk (points)
+    "influx_drop",        # sender dropped points (points)
+})
+
+#: ring-buffer depth backing /events (independent of file logging)
+RING_DEPTH = 1024
+
+
+def run_key_fingerprint(run_key: dict) -> str:
+    """Stable 16-hex digest of a resilience run key (canonical JSON).
+
+    Recomputable from a journal header's ``run_key`` dict, so event-log
+    records and journal units join on ``(fingerprint, unit)`` without
+    the consumer needing the full key in every record.
+    """
+    blob = json.dumps(dict(run_key or {}), sort_keys=True,
+                      separators=(",", ":"), default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:16]
+
+
+class TelemetryHub:
+    """Thread-safe composition point for the live telemetry plane."""
+
+    def __init__(self):
+        # RLock: emit() can be re-entered by a signal handler that fires
+        # while the main thread is inside emit()/snapshot()
+        self._lock = threading.RLock()
+        self._ring: deque = deque(maxlen=RING_DEPTH)
+        self._seq = 0
+        self._dropped_events = 0
+        self._event_fh = None
+        self._event_path = ""
+        self._run_fp = ""
+        self._progress: dict[str, dict] = {}   # label -> latest state
+        self._providers: dict[str, object] = {}  # name -> () -> dict
+        self._t0 = time.time()
+
+    # -- identity ---------------------------------------------------------
+
+    def set_run_key(self, run_key: dict) -> str:
+        """Stamp the run-key fingerprint carried by subsequent events."""
+        fp = run_key_fingerprint(run_key)
+        with self._lock:
+            self._run_fp = fp
+        return fp
+
+    def run_fingerprint(self) -> str:
+        with self._lock:
+            return self._run_fp
+
+    # -- event log --------------------------------------------------------
+
+    def open_event_log(self, path: str) -> None:
+        """Open (append) the JSONL event log.  Append mode is load-bearing:
+        an interrupted-and-resumed run reuses the same path, and the
+        resumed process must extend the record, not erase it."""
+        with self._lock:
+            self.close_event_log()
+            self._event_fh = open(path, "a", encoding="utf-8")
+            self._event_path = path
+
+    def close_event_log(self) -> None:
+        with self._lock:
+            if self._event_fh is not None:
+                try:
+                    self._event_fh.close()
+                except OSError:  # pragma: no cover - best-effort close
+                    pass
+                self._event_fh = None
+
+    @property
+    def event_log_path(self) -> str:
+        with self._lock:
+            return self._event_path
+
+    def emit(self, event_type: str, unit: int | None = None,
+             run: str | None = None, **fields) -> dict | None:
+        """Append one structured event (ring buffer + optional JSONL).
+
+        Never raises: a full disk or closed handle must not kill the
+        run — failed file writes are counted, the ring still advances.
+        """
+        try:
+            with self._lock:
+                self._seq += 1
+                rec = {"schema": EVENT_SCHEMA, "seq": self._seq,
+                       "ts": round(time.time(), 6), "ev": str(event_type),
+                       "run": self._run_fp if run is None else str(run)}
+                if unit is not None:
+                    rec["unit"] = int(unit)
+                for k, v in fields.items():
+                    if v is not None:
+                        rec[k] = v
+                self._ring.append(rec)
+                if self._event_fh is not None:
+                    try:
+                        self._event_fh.write(
+                            json.dumps(rec, separators=(",", ":"),
+                                       default=str) + "\n")
+                        self._event_fh.flush()
+                    except (OSError, ValueError):
+                        self._dropped_events += 1
+                return rec
+        except Exception:  # pragma: no cover - emit must never kill a run
+            return None
+
+    def recent_events(self, n: int = 100) -> list:
+        """Most recent ``n`` events (oldest first) from the ring buffer."""
+        with self._lock:
+            n = max(0, int(n))
+            ring = list(self._ring)
+        return ring[-n:] if n else []
+
+    def events_emitted(self) -> int:
+        with self._lock:
+            return self._seq
+
+    # -- live progress (heartbeats) ---------------------------------------
+
+    def note_progress(self, label: str, state: dict) -> None:
+        """Record a heartbeat's latest structured state (every beat()
+        call, including log-suppressed ones, keeps this fresh)."""
+        with self._lock:
+            self._progress[str(label)] = dict(state)
+
+    # -- live providers (Influx sender, ...) ------------------------------
+
+    def set_provider(self, name: str, fn) -> None:
+        """Register a callable returning a JSON-safe dict, polled at
+        snapshot time (e.g. the Influx sender's live stats)."""
+        with self._lock:
+            if fn is None:
+                self._providers.pop(name, None)
+            else:
+                self._providers[name] = fn
+
+    # -- the composed snapshot --------------------------------------------
+
+    def snapshot(self) -> dict:
+        """One consistent point-in-time view of the whole run.
+
+        The span registry's snapshot is atomic under its own lock (no
+        torn span [total_s, count] pairs); hub-owned state is copied
+        under the hub lock; providers are polled outside both locks so a
+        slow sender can't block emitters.
+        """
+        reg = get_registry()
+        snap = reg.snapshot()
+        info = snap["info"]
+        with self._lock:
+            progress = {k: dict(v) for k, v in self._progress.items()}
+            providers = dict(self._providers)
+            events = {"emitted": self._seq,
+                      "dropped_writes": self._dropped_events,
+                      "log": self._event_path,
+                      "buffered": len(self._ring)}
+            run_fp = self._run_fp
+            t0 = self._t0
+        polled = {}
+        for name, fn in providers.items():
+            try:
+                polled[name] = dict(fn())
+            except Exception:  # pragma: no cover - provider must not kill
+                polled[name] = {}
+        counters = snap["counters"]
+        out = {
+            "schema": TELEMETRY_SCHEMA,
+            "ts": round(time.time(), 6),
+            "pid": os.getpid(),
+            "run": {
+                "fingerprint": run_fp,
+                "platform": str(info.get("platform", "unknown")),
+                "num_nodes": int(info.get("num_nodes", 0) or 0),
+                "run_path": str(info.get("run_path", "")),
+                "started_unix": round(t0, 3),
+                "wall_s": round(snap["wall_s"], 3),
+            },
+            "spans": snap["spans"],
+            "counters": counters,
+            "progress": progress,
+            "engine": {
+                "compiles": int(counters.get("engine/compiles", 0)),
+                "cache_hits": int(counters.get("engine/cache_hits", 0)),
+            },
+            "resilience": {
+                "committed_units":
+                    int(counters.get("resilience/committed_units", 0)),
+                "resumed_units":
+                    int(counters.get("resilience/resumed_units", 0)),
+                "device_failures":
+                    int(counters.get("resilience/device_failures", 0)),
+                "fallback_units":
+                    int(counters.get("resilience/fallback_units", 0)),
+            },
+            "capacity": _capacity_view(info),
+            "health": _health_view(info),
+            "memwatch": _memwatch_view(),
+            "influx": polled.get("influx", {}),
+            "events": events,
+        }
+        return out
+
+    # -- lifecycle --------------------------------------------------------
+
+    def reset(self) -> None:
+        """One process == one run: drop ring/progress/providers and close
+        any event log a previous in-process run left open."""
+        with self._lock:
+            self.close_event_log()
+            self._ring.clear()
+            self._seq = 0
+            self._dropped_events = 0
+            self._event_path = ""
+            self._run_fp = ""
+            self._progress.clear()
+            self._providers.clear()
+            self._t0 = time.time()
+
+
+def _capacity_view(info: dict) -> dict:
+    led = dict(info.get("capacity_ledger") or {})
+    return {
+        "ledger_total_bytes": int(led.get("total_bytes", 0) or 0),
+        "ledger_bytes_per_node": float(led.get("bytes_per_node", 0) or 0),
+    }
+
+
+def _health_view(info: dict) -> dict:
+    nh = info.get("node_health") or {}
+    return {"enabled": bool(nh.get("enabled", False))}
+
+
+def _memwatch_view() -> dict:
+    try:
+        from . import memwatch
+        mw = memwatch.snapshot()
+        return {
+            "rss_bytes": int(mw.get("last_rss_bytes", 0)),
+            "peak_rss_bytes": int(mw.get("peak_rss_bytes", 0)),
+            "peak_device_bytes": int(mw.get("peak_device_bytes", 0)),
+            "samples": int(mw.get("samples", 0)),
+        }
+    except Exception:  # pragma: no cover - snapshot must never fail
+        return {"rss_bytes": 0, "peak_rss_bytes": 0,
+                "peak_device_bytes": 0, "samples": 0}
+
+
+# -- event validation (the v1 schema contract) ----------------------------
+
+#: required fields and accepted types for every v1 event record
+_EVENT_REQUIRED = {
+    "schema": str,
+    "seq": int,
+    "ts": (int, float),
+    "ev": str,
+    "run": str,
+}
+
+
+def validate_event(rec) -> list:
+    """Schema check for one event record: list of problems (empty=ok)."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"event is {type(rec).__name__}, not dict"]
+    for key, types in _EVENT_REQUIRED.items():
+        if key not in rec:
+            problems.append(f"missing key: {key}")
+        elif not isinstance(rec[key], types):
+            problems.append(f"key {key}: expected {types}, got "
+                            f"{type(rec[key]).__name__}")
+    if rec.get("schema") != EVENT_SCHEMA:
+        problems.append(f"unknown schema: {rec.get('schema')!r}")
+    if "ev" in rec and rec["ev"] not in EVENT_TYPES:
+        problems.append(f"unknown event type: {rec['ev']!r}")
+    if "unit" in rec and not isinstance(rec["unit"], int):
+        problems.append("unit must be int")
+    if "seq" in rec and isinstance(rec["seq"], int) and rec["seq"] < 1:
+        problems.append("seq must be >= 1")
+    return problems
+
+
+def validate_event_log(path: str) -> list:
+    """Validate a JSONL event log file: every line parses, every record
+    passes :func:`validate_event`, and seq is strictly increasing within
+    each process run (seq restarts at 1 when a resumed process appends
+    to the same file — detected by a seq drop back to 1)."""
+    problems = []
+    last_seq = 0
+    try:
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    problems.append(f"line {i}: unparseable JSON ({e})")
+                    continue
+                for p in validate_event(rec):
+                    problems.append(f"line {i}: {p}")
+                seq = rec.get("seq")
+                if isinstance(seq, int):
+                    if seq != 1 and seq <= last_seq:
+                        problems.append(
+                            f"line {i}: seq {seq} not increasing "
+                            f"(prev {last_seq})")
+                    last_seq = seq
+    except OSError as e:
+        problems.append(f"unreadable: {e}")
+    return problems
+
+
+def load_event_log(path: str) -> list:
+    """All parseable records of a JSONL event log, in file order."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out
+
+
+# -- module singleton (one process == one run) ----------------------------
+
+_HUB = TelemetryHub()
+
+
+def get_hub() -> TelemetryHub:
+    """The process-wide hub (one process == one run)."""
+    return _HUB
+
+
+def emit_event(event_type: str, unit: int | None = None,
+               run: str | None = None, **fields) -> dict | None:
+    """``telemetry.emit_event("journal_commit", unit=3)`` on the hub."""
+    return _HUB.emit(event_type, unit=unit, run=run, **fields)
+
+
+def reset() -> None:
+    """Reset the shared hub (joins cli.main's per-run reset block)."""
+    _HUB.reset()

@@ -49,7 +49,7 @@ import signal
 import threading
 import time
 
-from .obs import get_registry
+from .obs import get_registry, telemetry
 
 log = logging.getLogger(__name__)
 
@@ -134,11 +134,17 @@ class RunJournal:
     def __init__(self, path: str, run_key: dict, resume: bool = False):
         self.path = path
         self.run_key = dict(run_key)
+        # the fingerprint every event this journal emits carries — the
+        # join key between the event log and the journal's units
+        self.fingerprint = telemetry.run_key_fingerprint(self.run_key)
+        telemetry.get_hub().set_run_key(self.run_key)
         self.records: dict[int, dict] = {}
         self._fh = None
         existed = os.path.exists(path)
         if resume and existed:
             self._load()
+            telemetry.emit_event("journal_resume", run=self.fingerprint,
+                                 units=len(self.records), path=self.path)
         elif existed:
             log.warning("WARNING: overwriting existing journal %s (no "
                         "--resume given); the prior run's committed units "
@@ -218,6 +224,9 @@ class RunJournal:
         os.fsync(self._fh.fileno())
         self.records[int(unit)] = payload
         get_registry().add("resilience/committed_units", 1)
+        telemetry.emit_event("journal_commit", unit=int(unit),
+                             run=self.fingerprint,
+                             kind=str(self.run_key.get("kind", "")))
         note_unit_committed()
 
     def close(self) -> None:
@@ -492,6 +501,9 @@ def signal_guard():
                 "received signal %s: finishing the in-flight unit, "
                 "committing the journal, and exiting with the resumable "
                 "exit code %s", signum, RESUMABLE_EXIT_CODE)
+            # the hub lock is an RLock precisely so this emit is safe
+            # even when the signal lands mid-emit on the main thread
+            telemetry.emit_event("shutdown_signal", signum=int(signum))
             _shutdown_event.set()
 
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -654,6 +666,9 @@ def supervised_call(label: str, attempt_fn, policy: DispatchPolicy,
             # harvest's compile-cache keying so the re-run re-harvests
             # (obs/capacity.py) instead of reusing the pre-failure entry
             _bump_capacity_epoch()
+            telemetry.emit_event("device_retry", label=label,
+                                 attempt=attempt + 1,
+                                 error=f"{type(e).__name__}: {e}"[:200])
             if attempt < policy.retries:
                 log.warning("device dispatch '%s' failed (attempt %s/%s): "
                             "%s — retrying in %.2fs", label, attempt + 1,
@@ -665,6 +680,8 @@ def supervised_call(label: str, attempt_fn, policy: DispatchPolicy,
                     "re-executing the unit on the CPU fallback path",
                     label, policy.retries + 1)
         reg.add("resilience/fallback_units", 1)
+        telemetry.emit_event("device_fallback", label=label,
+                             attempts=policy.retries + 1)
         _bump_capacity_epoch()
         # the fault hook injects *device* failures; the fallback arm runs
         # clean, as a healthy CPU re-execution would

@@ -35,6 +35,8 @@ import urllib.parse
 import urllib.request
 from collections import deque
 
+from ..obs import telemetry as _telemetry
+
 log = logging.getLogger(__name__)
 
 
@@ -458,9 +460,12 @@ class InfluxDB:
         if body and self.spool_path and self._spool(body):
             with self._send_lock:
                 self.spooled_points += 1
+            _telemetry.emit_event("influx_spool", points=1,
+                                  path=self.spool_path)
             return
         with self._send_lock:
             self.dropped_points += 1
+        _telemetry.emit_event("influx_drop", points=1)
 
     def _spool(self, body: str) -> bool:
         """Append one point's line-protocol body to the spool file.
@@ -525,6 +530,9 @@ class InfluxDB:
                 if retryable and attempt < self.max_retries:
                     with self._send_lock:
                         self.retry_count += 1
+                    _telemetry.emit_event("influx_retry",
+                                          attempt=attempt + 1,
+                                          error=str(err)[:200])
                     log.warning("InfluxDB send failed (attempt %s/%s): %s — "
                                 "retrying in %.2fs", attempt + 1,
                                 self.max_retries + 1, err, delay)

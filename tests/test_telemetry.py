@@ -1,0 +1,398 @@
+"""Live telemetry plane (ISSUE 18): hub, event schema, heartbeat
+events, exporter endpoints, and the report's telemetry section.
+
+JAX-free by design — obs/telemetry.py and obs/exporter.py must import
+and operate without touching an accelerator.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from gossip_sim_tpu.obs import telemetry
+from gossip_sim_tpu.obs.exporter import (PROMETHEUS_CONTENT_TYPE,
+                                         TelemetryServer,
+                                         parse_prometheus_text,
+                                         prometheus_text)
+from gossip_sim_tpu.obs.heartbeat import Heartbeat
+from gossip_sim_tpu.obs.spans import get_registry
+from gossip_sim_tpu.obs.telemetry import (EVENT_SCHEMA, TELEMETRY_SCHEMA,
+                                          TelemetryHub, run_key_fingerprint,
+                                          validate_event, validate_event_log)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    get_registry().reset()
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    get_registry().reset()
+
+
+# --------------------------------------------------------------------------
+# run-key fingerprint (the event-log <-> journal join key)
+# --------------------------------------------------------------------------
+
+def test_run_key_fingerprint_stable_and_order_independent():
+    a = run_key_fingerprint({"kind": "lane-sweep", "seed": 11, "n": 300})
+    b = run_key_fingerprint({"n": 300, "seed": 11, "kind": "lane-sweep"})
+    assert a == b
+    assert len(a) == 16 and int(a, 16) >= 0
+    assert a != run_key_fingerprint({"kind": "lane-sweep", "seed": 12,
+                                     "n": 300})
+
+
+def test_run_key_fingerprint_survives_non_json_values():
+    # journal run keys carry enums/StepSize — default=str must cover them
+    class Odd:
+        def __str__(self):
+            return "odd"
+    assert run_key_fingerprint({"x": Odd()}) == \
+        run_key_fingerprint({"x": Odd()})
+
+
+# --------------------------------------------------------------------------
+# hub: events, ring, file log
+# --------------------------------------------------------------------------
+
+def test_emit_assigns_seq_and_carries_fingerprint():
+    hub = TelemetryHub()
+    fp = hub.set_run_key({"kind": "run"})
+    r1 = hub.emit("run_start", pid=1)
+    r2 = hub.emit("journal_commit", unit=3)
+    assert (r1["seq"], r2["seq"]) == (1, 2)
+    assert r1["run"] == r2["run"] == fp
+    assert r2["unit"] == 3 and isinstance(r2["unit"], int)
+    assert hub.events_emitted() == 2
+    assert [e["ev"] for e in hub.recent_events()] == ["run_start",
+                                                     "journal_commit"]
+    assert validate_event(r1) == [] and validate_event(r2) == []
+
+
+def test_emit_never_raises_on_bad_payload():
+    hub = TelemetryHub()
+    # non-int unit would blow int() — emit must swallow, not kill the run
+    assert hub.emit("journal_commit", unit="iter") is None
+
+
+def test_event_log_appends_and_validates(tmp_path):
+    path = str(tmp_path / "run.events")
+    hub = TelemetryHub()
+    hub.set_run_key({"kind": "run"})
+    hub.open_event_log(path)
+    hub.emit("run_start", pid=7)
+    hub.emit("run_end", rc=0)
+    hub.close_event_log()
+    assert validate_event_log(path) == []
+    recs = telemetry.load_event_log(path)
+    assert [r["ev"] for r in recs] == ["run_start", "run_end"]
+    assert all(r["schema"] == EVENT_SCHEMA for r in recs)
+
+
+def test_event_log_seq_restart_tolerated_not_regression(tmp_path):
+    """A resumed process appends to the same file with seq restarting at
+    1 — valid; a seq going sideways mid-run is not."""
+    path = str(tmp_path / "resumed.events")
+    hub = TelemetryHub()
+    hub.open_event_log(path)
+    hub.emit("run_start")
+    hub.emit("shutdown_signal", signum=15)
+    hub.reset()                      # "process" boundary: seq back to 0
+    hub.open_event_log(path)         # append mode: same file
+    hub.emit("run_start")
+    hub.emit("run_end", rc=0)
+    hub.close_event_log()
+    assert validate_event_log(path) == []
+    # corrupt: duplicate a non-1 seq
+    with open(path, "a") as f:
+        f.write(json.dumps({"schema": EVENT_SCHEMA, "seq": 2, "ts": 1.0,
+                            "ev": "run_end", "run": ""}) + "\n")
+    assert any("not increasing" in p for p in validate_event_log(path))
+
+
+def test_validate_event_rejects_junk():
+    good = {"schema": EVENT_SCHEMA, "seq": 1, "ts": 1.0, "ev": "run_start",
+            "run": ""}
+    assert validate_event(good) == []
+    assert any("unknown event type" in p
+               for p in validate_event({**good, "ev": "made_up"}))
+    assert any("missing key" in p
+               for p in validate_event({k: v for k, v in good.items()
+                                        if k != "ts"}))
+    assert any("unknown schema" in p
+               for p in validate_event({**good, "schema": "v0"}))
+    assert any("unit must be int" in p
+               for p in validate_event({**good, "unit": "three"}))
+    assert validate_event([]) != []
+
+
+def test_ring_buffer_bounded():
+    hub = TelemetryHub()
+    for _ in range(telemetry.RING_DEPTH + 50):
+        hub.emit("heartbeat", done=1)
+    assert len(hub.recent_events(telemetry.RING_DEPTH * 2)) == \
+        telemetry.RING_DEPTH
+    assert hub.events_emitted() == telemetry.RING_DEPTH + 50
+
+
+# --------------------------------------------------------------------------
+# heartbeat: every beat feeds the hub; logged ticks become events
+# --------------------------------------------------------------------------
+
+def test_heartbeat_state_edge_cases():
+    hb = Heartbeat(total_units=0, label="empty")
+    st = hb.state(0, now=hb._t0)     # zero-step + zero-elapsed first tick
+    assert st["eta_s"] is None and st["rate_per_s"] == 0.0
+    assert st["pct"] == 0.0
+
+    hb = Heartbeat(total_units=10, label="loop")
+    st = hb.state(15, now=hb._t0 + 1.0)   # overshoot: clamped, raw kept
+    assert st["done"] == 10 and st["raw_done"] == 15
+    assert st["eta_s"] == 0.0             # finished => ETA 0 always
+    st = hb.state(-3, now=hb._t0 + 1.0)
+    assert st["done"] == 0 and st["eta_s"] is None
+
+    hb = Heartbeat(total_units=4, label="half")
+    st = hb.state(2, now=hb._t0 + 2.0)    # 1 unit/s, 2 left
+    assert st["rate_per_s"] == pytest.approx(1.0)
+    assert st["eta_s"] == pytest.approx(2.0)
+
+
+def test_heartbeat_feeds_hub_even_when_log_suppressed():
+    hub = telemetry.get_hub()
+    hb = Heartbeat(total_units=8, label="quiet", interval_s=3600)
+    hb.beat(1)                       # first beat inside the interval
+    assert hub.events_emitted() == 0  # suppressed => no event
+    snap = hub.snapshot()
+    assert snap["progress"]["quiet"]["done"] == 1
+    hb.beat(5)
+    assert hub.snapshot()["progress"]["quiet"]["done"] == 5
+
+
+def test_heartbeat_logged_tick_emits_event_with_unit_name():
+    hub = telemetry.get_hub()
+    hb = Heartbeat(total_units=3, label="sweep", unit="point",
+                   interval_s=3600)
+    hb.finish()                      # forced tick => logged => event
+    evs = hub.recent_events()
+    assert [e["ev"] for e in evs] == ["heartbeat"]
+    ev = evs[0]
+    # "unit" is reserved for int journal unit ids; the name travels apart
+    assert "unit" not in ev and ev["unit_name"] == "point"
+    assert ev["done"] == ev["total"] == 3 and ev["eta_s"] == 0.0
+    assert validate_event(ev) == []
+
+
+# --------------------------------------------------------------------------
+# satellite: live Influx sender stats through the hub
+# --------------------------------------------------------------------------
+
+def test_influx_sender_stats_advance_through_live_snapshots():
+    from gossip_sim_tpu.sinks.influx import InfluxDB
+    db = InfluxDB("http://127.0.0.1:1", "u", "p", "gossip")
+    hub = telemetry.get_hub()
+    hub.set_provider("influx", db.sender_stats)
+
+    before = hub.snapshot()["influx"]
+    assert before["points_sent"] == 0 and before["dropped_points"] == 0
+    db.points_sent += 3              # what a 2xx ack does
+    db.retry_count += 1
+    db._count_dropped()              # no spool path => dropped
+    after = hub.snapshot()["influx"]
+    assert after["points_sent"] == 3
+    assert after["retries"] == 1
+    assert after["dropped_points"] == 1
+    # and the drop was also a structured event
+    assert [e["ev"] for e in hub.recent_events()] == ["influx_drop"]
+    # the exporter renders the live numbers, not an end-of-run copy
+    metrics = parse_prometheus_text(prometheus_text(hub.snapshot()))
+    assert metrics["gossip_sim_influx_points_sent_total"][""] == 3.0
+    assert metrics["gossip_sim_influx_retries_total"][""] == 1.0
+
+
+def test_provider_failure_never_breaks_snapshot():
+    hub = telemetry.get_hub()
+    hub.set_provider("influx", lambda: 1 / 0)
+    assert hub.snapshot()["influx"] == {}
+    hub.set_provider("influx", None)     # deregister
+    assert hub.snapshot()["influx"] == {}
+
+
+# --------------------------------------------------------------------------
+# satellite: concurrent scrape during mutation — no torn reads
+# --------------------------------------------------------------------------
+
+def test_concurrent_snapshot_consistency_under_mutation():
+    hub = telemetry.get_hub()
+    hub.set_run_key({"kind": "torture"})
+    reg = get_registry()
+    stop = threading.Event()
+    errors = []
+
+    def mutate():
+        i = 0
+        while not stop.is_set():
+            reg.record("engine/rounds", 0.001)
+            reg.add("origin_iters", 2)
+            hub.emit("heartbeat", done=i)
+            hub.note_progress("loop", {"done": i, "total": 10 ** 6})
+            i += 1
+
+    def scrape():
+        last_seq = 0
+        last_oi = 0.0
+        last_span = 0
+        try:
+            for _ in range(300):
+                snap = hub.snapshot()
+                assert snap["schema"] == TELEMETRY_SCHEMA
+                # counters monotone across successive snapshots
+                oi = snap["counters"].get("origin_iters", 0)
+                assert oi >= last_oi
+                last_oi = oi
+                seq = snap["events"]["emitted"]
+                assert seq >= last_seq
+                last_seq = seq
+                # no torn span pairs: count monotone, totals coherent
+                span = snap["spans"].get("engine/rounds",
+                                         {"count": 0, "total_s": 0.0})
+                assert span["count"] >= last_span
+                last_span = span["count"]
+                assert span["total_s"] >= 0.0
+                if span["count"]:
+                    assert span["total_s"] == pytest.approx(
+                        0.001 * span["count"], rel=0.5)
+                # the exporter path must render every snapshot strictly
+                parse_prometheus_text(prometheus_text(snap))
+        except Exception as e:  # surfaced on the main thread below
+            errors.append(e)
+
+    writer = threading.Thread(target=mutate)
+    reader = threading.Thread(target=scrape)
+    writer.start()
+    reader.start()
+    reader.join(timeout=60)
+    stop.set()
+    writer.join(timeout=60)
+    assert not errors, errors
+    assert hub.events_emitted() > 0
+
+
+# --------------------------------------------------------------------------
+# exporter: endpoints + exposition format
+# --------------------------------------------------------------------------
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.headers.get("Content-Type", ""), resp.read()
+
+
+def test_exporter_serves_metrics_status_events():
+    hub = telemetry.get_hub()
+    hub.set_run_key({"kind": "run"})
+    hub.emit("run_start", pid=1)
+    get_registry().add("origin_iters", 42)
+    server = TelemetryServer(port=0)
+    try:
+        port = server.start()
+        assert port > 0 and server.running
+        base = f"http://127.0.0.1:{port}"
+        # the bound port is discoverable from the event ring + registry
+        assert [e["ev"] for e in hub.recent_events()][-1] == \
+            "telemetry_listen"
+        assert get_registry().snapshot()["info"]["telemetry_port"] == port
+
+        ctype, body = _get(base + "/metrics")
+        assert ctype == PROMETHEUS_CONTENT_TYPE
+        metrics = parse_prometheus_text(body.decode())
+        assert metrics["gossip_sim_counter_total"][
+            '{counter="origin_iters"}'] == 42.0
+        assert metrics["gossip_sim_events_emitted_total"][""] >= 2.0
+
+        ctype, body = _get(base + "/status")
+        assert ctype.startswith("application/json")
+        status = json.loads(body)
+        assert status["schema"] == TELEMETRY_SCHEMA  # default status fn
+
+        _, body = _get(base + "/events?n=1")
+        doc = json.loads(body)
+        assert doc["schema"] == EVENT_SCHEMA
+        assert len(doc["events"]) == 1
+
+        with pytest.raises(urllib.error.HTTPError):
+            _get(base + "/nope")
+        # scrapes are themselves observable
+        assert get_registry().counter("telemetry/scrapes") >= 3
+    finally:
+        server.stop()
+    assert not server.running
+
+
+def test_exporter_custom_status_fn_and_error_isolation():
+    calls = []
+
+    def status_fn():
+        calls.append(1)
+        if len(calls) > 1:
+            raise RuntimeError("mid-run assembly hiccup")
+        return {"schema": "custom", "ok": True}
+
+    server = TelemetryServer(port=0, status_fn=status_fn)
+    try:
+        port = server.start()
+        _, body = _get(f"http://127.0.0.1:{port}/status")
+        assert json.loads(body)["ok"] is True
+        _, body = _get(f"http://127.0.0.1:{port}/status")
+        assert "error" in json.loads(body)   # never a dead endpoint
+    finally:
+        server.stop()
+
+
+def test_prometheus_text_escapes_and_reparses():
+    hub = TelemetryHub()
+    hub.note_progress('we"ird\\lab\nel', {"done": 1, "total": 2,
+                                          "pct": 50.0, "rate_per_s": 0.5,
+                                          "eta_s": None})
+    text = prometheus_text(hub.snapshot())
+    metrics = parse_prometheus_text(text)    # strict: raises on bad lines
+    assert len(metrics["gossip_sim_progress_done"]) == 1
+    # eta None renders as the -1 "unknown" sentinel
+    assert list(metrics["gossip_sim_progress_eta_seconds"].values()) == [-1.0]
+
+
+def test_parse_prometheus_text_is_strict():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("no_value_here\n")
+    with pytest.raises(ValueError):
+        parse_prometheus_text("bad-name{} 1\n")
+    with pytest.raises(ValueError):
+        parse_prometheus_text('unterminated{a="b" 1\n')
+
+
+# --------------------------------------------------------------------------
+# run report: the telemetry section
+# --------------------------------------------------------------------------
+
+def test_run_report_carries_telemetry_section(tmp_path):
+    from gossip_sim_tpu.config import Config
+    from gossip_sim_tpu.obs.report import (REQUIRED_KEYS, build_run_report,
+                                           validate_run_report)
+    assert "telemetry" in REQUIRED_KEYS
+    hub = telemetry.get_hub()
+    fp = hub.set_run_key({"kind": "run"})
+    hub.open_event_log(str(tmp_path / "r.events"))
+    hub.emit("run_start")
+    reg = get_registry()
+    reg.set_info("telemetry_port", 12345)
+    reg.add("telemetry/scrapes", 4)
+    report = build_run_report(Config(), reg)
+    assert validate_run_report(report) == []
+    tel = report["telemetry"]
+    assert tel["port"] == 12345
+    assert tel["run_fingerprint"] == fp
+    assert tel["events_emitted"] == 1
+    assert tel["event_log"].endswith("r.events")
+    assert tel["scrapes"] == 4

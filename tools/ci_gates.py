@@ -30,15 +30,23 @@ The gates, in dependency-light-first order:
                 lines, 1k-node engine-vs-oracle health-plane parity
                 under faults, digest decile sums equal cluster
                 aggregates (device == numpy), overhead < 2%
+  telemetry_smoke live telemetry plane (ISSUE 18): mid-run /metrics +
+                /status scrape of a live 1k-node traffic run on an
+                ephemeral --telemetry-port (valid Prometheus text,
+                schema-valid JSON, advancing round counters), event-log
+                v1 schema validation with a 1:1 join against the run
+                journal's committed units, zero bit-impact, overhead <2%
 
-Usage: python tools/ci_gates.py [--only NAME[,NAME...]] [--list]
+Usage: python tools/ci_gates.py [--only NAME[,NAME...]] [--list] [--json]
 
-``--only`` runs a subset (eleven serial gates take a while — pick the ones
-your change touches); ``--list`` prints the registry and exits.  The
-summary table carries each gate's wall time.
+``--only`` runs a subset (twelve serial gates take a while — pick the
+ones your change touches); ``--list`` prints the registry and exits.
+The summary table carries each gate's wall time; ``--json`` replaces it
+with one machine-readable JSON object (the last line of output) carrying
+per-gate status/rc/wall-time for CI dashboards.
 
 Exit code 0 = every gate passed; 1 = at least one failed (each gate's
-output streams through, and a summary table prints at the end).
+output streams through, and a summary prints at the end).
 """
 import argparse
 import os
@@ -49,7 +57,8 @@ import time
 HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
          "pull_smoke", "lane_smoke", "resume_smoke", "traffic_smoke",
-         "adaptive_smoke", "capacity_smoke", "health_smoke"]
+         "adaptive_smoke", "capacity_smoke", "health_smoke",
+         "telemetry_smoke"]
 
 
 def main() -> int:
@@ -58,6 +67,10 @@ def main() -> int:
                     help="comma-separated subset of gates to run")
     ap.add_argument("--list", action="store_true",
                     help="print the gate registry and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one machine-readable JSON "
+                         "object (the last output line) instead of the "
+                         "human table")
     ap.add_argument("--timeout", type=int, default=600,
                     help="per-gate hard timeout (seconds)")
     args = ap.parse_args()
@@ -86,13 +99,24 @@ def main() -> int:
             rc = -9
         results.append((gate, rc, round(time.time() - t0, 1)))
 
+    failed = sum(rc != 0 for _, rc, _ in results)
+    if args.json:
+        import json
+        print(json.dumps({
+            "gates": [{"name": gate,
+                       "status": ("pass" if rc == 0 else
+                                  "timeout" if rc == -9 else "fail"),
+                       "rc": rc, "wall_s": dt}
+                      for gate, rc, dt in results],
+            "failed": failed,
+            "ok": failed == 0,
+        }, sort_keys=True))
+        return 1 if failed else 0
     print("\n===== CI gate summary =====")
-    failed = 0
     for gate, rc, dt in results:
         status = "PASS" if rc == 0 else ("TIMEOUT" if rc == -9
                                          else f"FAIL rc={rc}")
-        failed += rc != 0
-        print(f"  {gate:<14} {status:<12} {dt}s")
+        print(f"  {gate:<15} {status:<12} {dt}s")
     return 1 if failed else 0
 
 

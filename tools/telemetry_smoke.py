@@ -1,0 +1,319 @@
+"""Live-telemetry smoke test: the CI gate for obs/telemetry.py +
+obs/exporter.py (ISSUE 18).
+
+Fast CPU gate (~3 min) over four contracts:
+
+  1. **Mid-run scrape**: a live 1k-node traffic run started with
+     ``--telemetry-port 0`` (CLI on a background thread; signal_guard
+     no-ops off the main thread) has its ephemeral port discovered from
+     the event log's ``telemetry_listen`` record alone, then ``/metrics``
+     is polled until the ``origin_iters`` counter is nonzero AND
+     advances between scrapes — strictly-parsed Prometheus text the
+     whole way.  ``/status`` mid-run must be a schema-valid run report
+     with the bound port stamped; ``/events`` must be schema-valid JSON.
+  2. **Journal join**: a lane sweep is killed after its first committed
+     unit (rc 75), then ``--resume``d to completion — both processes*
+     appending to ONE ``--event-log``.  The log must validate against
+     the v1 schema (including the seq restart at the resume boundary),
+     and its ``journal_commit`` events must join 1:1 against the
+     journal's committed units on ``(run-key fingerprint, unit id)``,
+     with the fingerprint recomputed independently from the journal
+     header.  (*in-process runs: cli.main's reset block is the process
+     boundary under test.)
+  3. **Zero bit-impact**: the full plane — open event log, bound
+     exporter, a scraper thread hammering /metrics + /status throughout
+     the run — moves no bit of the stats parity snapshot or the
+     deterministic Influx wire lines.
+  4. **Overhead** < ``--overhead-budget`` (default 2%) + absolute timer
+     slack, obs_smoke-style: warm best-of-N CLI arms with and without
+     ``--telemetry-port 0 --event-log``.
+
+Usage: python tools/telemetry_smoke.py [--traffic-nodes 1000]
+       [--seed 7] [--reps 2] [--overhead-budget 0.02]
+       [--overhead-slack-s 0.2]
+
+Exit code 0 = the live-telemetry contract holds; 1 = it broke.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESUMABLE = 75
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="live telemetry plane smoke (CPU, <3 min)")
+    ap.add_argument("--traffic-nodes", type=int, default=1000,
+                    help="cluster size for the live mid-run scrape gate")
+    ap.add_argument("--traffic-iterations", type=int, default=600,
+                    help="traffic rounds (>=2 harvest blocks so the "
+                         "origin_iters counter visibly advances mid-run)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--reps", type=int, default=2)
+    ap.add_argument("--overhead-budget", type=float, default=0.02)
+    ap.add_argument("--overhead-slack-s", type=float, default=0.2)
+    ap.add_argument("--scrape-timeout-s", type=float, default=420.0,
+                    help="hard bound on the mid-run scrape gate")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from gossip_sim_tpu.cli import main as cli_main
+    from gossip_sim_tpu.cli import run_simulation
+    from gossip_sim_tpu.config import Config
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    from gossip_sim_tpu.obs import (get_registry, telemetry,
+                                    validate_run_report)
+    from gossip_sim_tpu.obs.exporter import (TelemetryServer,
+                                             parse_prometheus_text)
+    from gossip_sim_tpu.obs.telemetry import (EVENT_SCHEMA, load_event_log,
+                                              run_key_fingerprint,
+                                              validate_event,
+                                              validate_event_log)
+    from gossip_sim_tpu.sinks import DatapointQueue
+    from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+
+    t_start = time.time()
+    tmp = tempfile.mkdtemp(prefix="telemetry-smoke-")
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}", flush=True)
+        if not ok:
+            failures.append(msg)
+
+    # ---- gate 1: mid-run scrape of a live 1k-node traffic run -----------
+    print(f"telemetry smoke: live scrape n={args.traffic_nodes} "
+          f"iters={args.traffic_iterations}")
+    evt1 = os.path.join(tmp, "traffic.events")
+    run_result = {}
+
+    def run_traffic():
+        run_result["rc"] = cli_main(
+            ["--num-synthetic-nodes", str(args.traffic_nodes),
+             "--iterations", str(args.traffic_iterations),
+             "--warm-up-rounds", "4", "--seed", str(args.seed),
+             "--traffic-values", "4", "--traffic-rate", "2",
+             "--node-ingress-cap", "24", "--node-egress-cap", "32",
+             "--telemetry-port", "0", "--event-log", evt1])
+
+    th = threading.Thread(target=run_traffic, name="cli-under-test")
+    th.start()
+
+    # port discovery from the event log alone (the telemetry_watch path)
+    deadline = time.time() + args.scrape_timeout_s
+    port = None
+    while time.time() < deadline and th.is_alive() and port is None:
+        if os.path.exists(evt1):
+            for rec in load_event_log(evt1):
+                if rec.get("ev") == "telemetry_listen":
+                    port = rec.get("port")
+        if port is None:
+            time.sleep(0.05)
+    check(port is not None,
+          f"ephemeral port discovered from the event log ({port})")
+
+    first_oi = 0.0
+    advanced_oi = 0.0
+    mid_status = None
+    mid_events = None
+    mid_progress = False
+    base = f"http://127.0.0.1:{port}" if port else ""
+    while port and time.time() < deadline and th.is_alive():
+        try:
+            metrics = parse_prometheus_text(_get(base + "/metrics").decode())
+        except OSError:
+            break  # run finished between the liveness check and the GET
+        oi = metrics.get("gossip_sim_counter_total", {}).get(
+            '{counter="origin_iters"}', 0.0)
+        if oi > 0 and not first_oi:
+            first_oi = oi
+            # grab the other two endpoints now, provably mid-run
+            mid_status = json.loads(_get(base + "/status"))
+            mid_events = json.loads(_get(base + "/events"))
+            mid_progress = bool(metrics.get("gossip_sim_progress_done"))
+        elif first_oi and oi > first_oi:
+            advanced_oi = oi
+            break
+        time.sleep(0.025)
+    th.join(timeout=args.scrape_timeout_s)
+    check(not th.is_alive() and run_result.get("rc") == 0,
+          f"scraped traffic run exits 0 (rc={run_result.get('rc')})")
+    check(first_oi > 0,
+          f"mid-run /metrics scrape parsed strictly with nonzero "
+          f"origin_iters ({int(first_oi)})")
+    check(advanced_oi > first_oi,
+          f"round counters advance between mid-run scrapes "
+          f"({int(first_oi)} -> {int(advanced_oi)})")
+    check(mid_progress, "progress gauges present mid-run "
+                        "(gossip_sim_progress_done)")
+    if mid_status is not None:
+        check(validate_run_report(mid_status) == [],
+              "mid-run /status is a schema-valid run report")
+        check(mid_status.get("telemetry", {}).get("port") == port,
+              f"bound port stamped into the live report "
+              f"({mid_status.get('telemetry', {}).get('port')})")
+    else:
+        check(False, "mid-run /status scrape captured")
+    if mid_events is not None:
+        evs = mid_events.get("events", [])
+        check(mid_events.get("schema") == EVENT_SCHEMA and evs
+              and not any(p for e in evs for p in validate_event(e)),
+              f"mid-run /events is schema-valid JSON ({len(evs)} events)")
+    else:
+        check(False, "mid-run /events scrape captured")
+    log_problems = validate_event_log(evt1)
+    check(log_problems == [],
+          f"traffic event log validates against v1 "
+          f"({log_problems[:3] or 'clean'})")
+    kinds = {r.get("ev") for r in load_event_log(evt1)}
+    for want in ("run_start", "telemetry_listen", "heartbeat", "run_end"):
+        check(want in kinds, f"event log carries {want}")
+
+    # ---- gate 2: interrupted+resumed lane sweep joins the journal -------
+    ck = os.path.join(tmp, "sweep.npz")
+    evt2 = os.path.join(tmp, "sweep.events")
+    sweep_argv = ["--num-synthetic-nodes", "300", "--iterations", "10",
+                  "--warm-up-rounds", "4", "--seed", "11",
+                  "--test-type", "packet-loss", "--num-simulations", "6",
+                  "--step-size", "0.05", "--packet-loss-rate", "0.05",
+                  "--sweep-lanes", "2", "--checkpoint-path", ck,
+                  "--event-log", evt2]
+    os.environ["GOSSIP_RESILIENCE_KILL_AFTER_UNITS"] = "1"
+    try:
+        rc_kill = cli_main(sweep_argv)
+    finally:
+        del os.environ["GOSSIP_RESILIENCE_KILL_AFTER_UNITS"]
+    check(rc_kill == RESUMABLE,
+          f"killed lane sweep exits with the resumable code "
+          f"({rc_kill} == {RESUMABLE})")
+    rc_res = cli_main(sweep_argv + ["--resume", ck])
+    check(rc_res == 0, f"resumed lane sweep completes (rc={rc_res})")
+
+    log_problems = validate_event_log(evt2)
+    check(log_problems == [],
+          f"interrupted+resumed event log validates against v1, seq "
+          f"restart included ({log_problems[:3] or 'clean'})")
+    journal = ck[: -len(".npz")] + ".journal"
+    header, units = {}, []
+    if os.path.exists(journal):
+        with open(journal) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        header = json.loads(lines[0])
+        units = sorted(json.loads(ln)["unit"] for ln in lines[1:])
+    check(units == [0, 1, 2],
+          f"journal carries all three lane batches ({units})")
+    fp = run_key_fingerprint(header.get("run_key", {}))
+    recs = load_event_log(evt2)
+    commits = sorted((r["run"], r["unit"]) for r in recs
+                     if r.get("ev") == "journal_commit")
+    check(commits == [(fp, u) for u in units],
+          f"journal_commit events join 1:1 against journal units on "
+          f"(fingerprint, unit) — fp {fp}, {len(commits)} commit(s)")
+    kinds2 = {r.get("ev") for r in recs}
+    for want in ("shutdown_signal", "resumable_exit", "journal_resume"):
+        check(want in kinds2, f"event log carries {want}")
+    resumed = [r for r in recs if r.get("ev") == "journal_resume"]
+    check(bool(resumed) and resumed[0].get("units") == 1,
+          f"journal_resume reports the one replayed unit "
+          f"({resumed[0].get('units') if resumed else None})")
+
+    # ---- gate 3: zero bit-impact of the whole plane ---------------------
+    def run_single(instrument: bool):
+        reset_unique_pubkeys()
+        get_registry().reset()
+        telemetry.reset()
+        server = None
+        stop = threading.Event()
+        scraper = None
+        if instrument:
+            hub = telemetry.get_hub()
+            hub.open_event_log(os.path.join(tmp, "bits.events"))
+            hub.set_run_key({"kind": "bit-impact"})
+            server = TelemetryServer(port=0)
+            p = server.start()
+
+            def hammer():
+                while not stop.is_set():
+                    try:
+                        _get(f"http://127.0.0.1:{p}/metrics", timeout=2)
+                        _get(f"http://127.0.0.1:{p}/status", timeout=2)
+                    except OSError:
+                        pass
+                    time.sleep(0.005)
+
+            scraper = threading.Thread(target=hammer, daemon=True)
+            scraper.start()
+        try:
+            cfg = Config(num_synthetic_nodes=200, gossip_iterations=8,
+                         warm_up_rounds=2, seed=args.seed)
+            coll = GossipStatsCollection()
+            coll.set_number_of_simulations(1)
+            dpq = DatapointQueue()
+            run_simulation(cfg, "", coll, dpq, 0, "0", 0.0)
+            return (coll.collection[0].parity_snapshot(),
+                    dpq.drain_deterministic_lines())
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=5)
+            if server is not None:
+                server.stop()
+            telemetry.reset()
+
+    snap_a, wire_a = run_single(False)
+    snap_b, wire_b = run_single(True)
+    check(snap_a == snap_b, "event log + exporter + live scraping move "
+                            "zero bits of the stats parity snapshot")
+    check(wire_a == wire_b, "event log + exporter + live scraping move "
+                            "zero bits of the deterministic wire lines")
+
+    # ---- gate 4: overhead (obs_smoke-style warm A/B) --------------------
+    # large enough that the plane's fixed costs (exporter bind/teardown,
+    # event-log open) amortize the way they do on a real run
+    base_argv = ["--num-synthetic-nodes", "120", "--iterations", "48",
+                 "--warm-up-rounds", "4", "--seed", str(args.seed)]
+
+    def timed_run(extra):
+        t0 = time.perf_counter()
+        rc = cli_main(base_argv + extra)
+        check(rc == 0, f"overhead arm exits 0 ({extra or 'plain'})")
+        return time.perf_counter() - t0
+
+    tel_extra = ["--telemetry-port", "0",
+                 "--event-log", os.path.join(tmp, "oh.events")]
+    timed_run([])  # cold: warm the jit cache for both arms
+    t_plain = min(timed_run([]) for _ in range(max(1, args.reps)))
+    t_tel = min(timed_run(tel_extra) for _ in range(max(1, args.reps)))
+    budget = t_plain * (1.0 + args.overhead_budget) + args.overhead_slack_s
+    print(f"  plain={t_plain:.3f}s telemetry={t_tel:.3f}s "
+          f"delta={(t_tel - t_plain) / t_plain * 100 if t_plain else 0:+.2f}%")
+    check(t_tel <= budget,
+          f"telemetry overhead within {args.overhead_budget:.0%} "
+          f"+ {args.overhead_slack_s}s timer-noise slack "
+          f"(budget {budget:.3f}s)")
+
+    print(f"  elapsed: {time.time() - t_start:.1f}s")
+    if failures:
+        print(f"TELEMETRY SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("TELEMETRY SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""Terminal tailer for the live telemetry plane (ISSUE 18).
+
+Two modes:
+
+* **HTTP** (``--url http://127.0.0.1:PORT``): poll ``/status`` +
+  ``/metrics`` every ``--interval`` seconds and render a compact live
+  line per loop — progress/ETA per heartbeat label, origin-iters
+  throughput, RSS, Influx sender deliveries and queue-drop counters.
+  ``--once`` prints one frame and exits (scriptable).
+* **Event log** (``--event-log PATH``): pretty-print the structured
+  event stream (schema ``gossip-sim-tpu/events/v1``); ``--follow``
+  keeps tailing as the run appends.
+
+Discovering the port of a live run: the run logs it
+("telemetry: serving ... on http://127.0.0.1:PORT"), stamps it into the
+run report's ``telemetry.port``, and emits it as a ``telemetry_listen``
+event — so ``--event-log PATH --url auto`` resolves the port from the
+log's last ``telemetry_listen`` record.
+
+Zero dependencies beyond the stdlib; works against any run started with
+``--telemetry-port`` (single, sweeps, lanes, origin-rank, all-origins,
+traffic, oracle).
+
+Usage:
+  python tools/telemetry_watch.py --url http://127.0.0.1:8321
+  python tools/telemetry_watch.py --event-log run.events --url auto
+  python tools/telemetry_watch.py --event-log run.events --follow
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _get(url: str, timeout: float = 5.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _fmt_eta(eta_s) -> str:
+    if eta_s is None or eta_s < 0:
+        return "?"
+    s = int(eta_s)
+    return f"{s // 3600}:{s % 3600 // 60:02d}:{s % 60:02d}"
+
+
+def resolve_url(args) -> str:
+    """``--url auto``: pull the port from the event log's last
+    ``telemetry_listen`` record."""
+    if args.url != "auto":
+        return args.url.rstrip("/")
+    if not args.event_log:
+        raise SystemExit("--url auto needs --event-log to resolve the port")
+    from gossip_sim_tpu.obs.telemetry import load_event_log
+    port = host = None
+    for rec in load_event_log(args.event_log):
+        if rec.get("ev") == "telemetry_listen":
+            port = rec.get("port")
+            host = rec.get("host", "127.0.0.1")
+    if not port:
+        raise SystemExit(f"no telemetry_listen event in {args.event_log} "
+                         f"(was the run started with --telemetry-port?)")
+    return f"http://{host}:{port}"
+
+
+def render_frame(url: str) -> str:
+    """One status frame from /status + /metrics."""
+    status = json.loads(_get(url + "/status"))
+    metrics_raw = _get(url + "/metrics").decode()
+    # cheap metric pulls without a full parser dependency
+    from gossip_sim_tpu.obs.exporter import parse_prometheus_text
+    metrics = parse_prometheus_text(metrics_raw)
+
+    def m(name, default=0.0):
+        vals = metrics.get(f"gossip_sim_{name}")
+        if not vals:
+            return default
+        return next(iter(vals.values()))
+
+    lines = []
+    thr = status.get("throughput", {})
+    lines.append(
+        f"run: {status.get('platform', '?')} n={status.get('num_nodes', 0)} "
+        f"wall={thr.get('wall_s', 0):.1f}s "
+        f"oi/s={thr.get('origin_iters_per_sec', 0):.0f} "
+        f"compiles={status.get('compiles', 0)} "
+        f"cache_hits={status.get('cache_hits', 0)}")
+    # per-label progress gauges
+    done = metrics.get("gossip_sim_progress_done", {})
+    total = metrics.get("gossip_sim_progress_total", {})
+    pct = metrics.get("gossip_sim_progress_pct", {})
+    rate = metrics.get("gossip_sim_progress_rate", {})
+    eta = metrics.get("gossip_sim_progress_eta_seconds", {})
+    for labels in sorted(done):
+        label = labels.split('"')[1] if '"' in labels else labels
+        e = eta.get(labels, -1)
+        lines.append(
+            f"  {label}: {int(done[labels])}/{int(total.get(labels, 0))} "
+            f"({pct.get(labels, 0):.1f}%) {rate.get(labels, 0):.2f}/s "
+            f"ETA {_fmt_eta(None if e < 0 else e)}")
+    rss = m("rss_bytes")
+    peak = m("peak_rss_bytes")
+    lines.append(f"  rss: {_fmt_bytes(rss)} (peak {_fmt_bytes(peak)})")
+    influx = status.get("influx", {})
+    if influx:
+        lines.append(
+            f"  influx: sent={influx.get('points_sent', 0)} "
+            f"retries={influx.get('retries', 0)} "
+            f"spooled={influx.get('spooled_points', 0)} "
+            f"dropped={influx.get('dropped_points', 0)} "
+            f"queue={influx.get('queue_depth', 0)}")
+    # queue-drop / delivery counters (traffic + faulted runs)
+    counters = status.get("counters", {})
+    drops = {k: v for k, v in counters.items()
+             if "drop" in k or k == "messages_delivered"}
+    if drops:
+        lines.append("  counters: " + " ".join(
+            f"{k}={int(v)}" for k, v in sorted(drops.items())))
+    committed = m("journal_committed_units_total")
+    if committed:
+        lines.append(f"  journal: {int(committed)} unit(s) committed, "
+                     f"resumable")
+    ev = m("events_emitted_total")
+    lines.append(f"  events: {int(ev)} emitted")
+    return "\n".join(lines)
+
+
+def watch_http(args) -> int:
+    url = resolve_url(args)
+    while True:
+        try:
+            frame = render_frame(url)
+        except (OSError, ValueError) as e:
+            if args.once:
+                print(f"scrape failed: {e}", file=sys.stderr)
+                return 1
+            print(f"[{time.strftime('%H:%M:%S')}] scrape failed: {e} "
+                  f"(run finished?)")
+            return 0
+        print(f"[{time.strftime('%H:%M:%S')}] {url}")
+        print(frame)
+        if args.once:
+            return 0
+        time.sleep(max(0.2, args.interval))
+
+
+def _render_event(rec: dict) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(rec.get("ts", 0)))
+    ev = rec.get("ev", "?")
+    skip = {"schema", "seq", "ts", "ev", "run"}
+    detail = " ".join(f"{k}={rec[k]}" for k in rec if k not in skip)
+    run = rec.get("run", "")
+    return f"[{ts}] {ev:<16} {detail}" + (f"  (run {run})" if run else "")
+
+
+def watch_events(args) -> int:
+    path = args.event_log
+    try:
+        f = open(path, encoding="utf-8")
+    except OSError as e:
+        print(f"cannot open {path}: {e}", file=sys.stderr)
+        return 1
+    with f:
+        while True:
+            line = f.readline()
+            if line:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    print(_render_event(json.loads(line)))
+                except ValueError:
+                    pass
+                continue
+            if not args.follow:
+                return 0
+            time.sleep(0.25)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="live tailer for --telemetry-port / --event-log runs")
+    ap.add_argument("--url", default="",
+                    help="telemetry endpoint base (http://127.0.0.1:PORT); "
+                         "'auto' resolves the port from --event-log's "
+                         "telemetry_listen event")
+    ap.add_argument("--event-log", default="",
+                    help="structured event log to print/follow")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="poll interval for --url mode (seconds)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one frame and exit")
+    ap.add_argument("--follow", action="store_true",
+                    help="event-log mode: keep tailing as the run appends")
+    args = ap.parse_args()
+    if args.url:
+        return watch_http(args)
+    if args.event_log:
+        return watch_events(args)
+    ap.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
