@@ -49,9 +49,11 @@ LADDER = [
     (4_000, 16, 100, 600),
     (1_000, 8, 50, 420),
 ]
-# 900 s: the rung ran 596 s of the old 600 s budget in BENCH_r08, and the
-# ISSUE-13 capacity harvest adds one extra XLA compile per executable
-CPU_RUNG = (1_000, 4, 20, 900)
+# 1500 s: the rung ran 596 s of the old 600 s budget in BENCH_r08; the
+# ISSUE-13 capacity harvest adds one extra XLA compile per executable,
+# and two rungs landed since — health (ISSUE 17, ~a second traffic run)
+# and the n=10k sparse rung (ISSUE 19, one extra compile + timed rounds)
+CPU_RUNG = (1_000, 4, 20, 1500)
 
 
 def _env_number(name, default, cast):
@@ -346,6 +348,40 @@ def worker(args) -> int:
     hdig = health_obs.digest_stack(hstack, ttables_c.stake_decile, 10)
     digest_dt = time.perf_counter() - t_dg
 
+    # ---- sparse rung: the frontier representation past the dense wall --
+    # (engine/sparse.py, ISSUE 19).  Always runs at n=10,000 — the first
+    # size beyond the dense all-origins 16GB ceiling (~3.9k nodes) —
+    # regardless of the ladder rung, because that is the point of the
+    # representation: the rc stake planes leave SimState (derived from
+    # the cluster tables each round) and routing goes through the
+    # segment-reduce frontier kernels.  The per-round math is bit-exact
+    # vs dense (tools/sparse_smoke.py gates that), so steps/sec here is
+    # a pure representation-cost number, and the ledger bytes/node is
+    # the figure capacity_report.py --representation sparse projects.
+    sn, so = 10_000, o
+    sparse_iters = max(1, min(10, args.iterations))
+    sparams = EngineParams(num_nodes=sn, warm_up_rounds=0,
+                           representation="sparse").validate()
+    stables = make_cluster_tables(synthetic_stakes(sn))
+    sorigins = jnp.arange(so, dtype=jnp.int32)
+    sstate = init_state(jax.random.PRNGKey(0), stables, sorigins, sparams)
+    h0 = harvest_s()
+    t_sc = time.perf_counter()
+    sstate, sprows = run_rounds(sparams, stables, sorigins, sstate, 3)
+    jax.block_until_ready(sprows["coverage"])
+    sparse_compile_dt = time.perf_counter() - t_sc - (harvest_s() - h0)
+    h0 = harvest_s()
+    t_sr = time.perf_counter()
+    sstate, sprows = run_rounds(sparams, stables, sorigins, sstate,
+                                sparse_iters, start_it=3)
+    jax.block_until_ready(sprows["coverage"])
+    sparse_dt = time.perf_counter() - t_sr - (harvest_s() - h0)
+    sparse_cov = float(np.asarray(sprows["coverage"]).mean())
+    # site peaks at engine/run_rounds now include the sparse executables;
+    # at 10x the dense rung's N the maxima are the sparse graph's
+    sparse_capacity = rung_capacity(sparams, "engine/run_rounds",
+                                    origin_batch=so)
+
     result = bench_summary(
         reg, platform=platform, num_nodes=n, origin_batch=o,
         iterations=args.iterations,
@@ -433,6 +469,17 @@ def worker(args) -> int:
         "queue_dropped_total": int(np.asarray(hstate.qdrop_acc).sum()),
         "queue_dropped_gini": health_obs.gini_value(
             int(hdig["gini_num"][3]), int(hdig["gini_den"][3])),
+    }
+    result["sparse_steps_per_sec"] = round(
+        sparse_iters / sparse_dt, 2) if sparse_dt > 0 else 0.0
+    result["sparse"] = {
+        "num_nodes": sn,
+        "origin_batch": so,
+        "timed_rounds": sparse_iters,
+        "warm_elapsed_s": round(sparse_dt, 3),
+        "first_call_elapsed_s": round(sparse_compile_dt, 3),
+        "coverage_mean": round(sparse_cov, 4),
+        **sparse_capacity,
     }
     # run-level capacity line (ROADMAP item 1's measured memory baseline;
     # tools/bench_trend.py tracks these across rounds)

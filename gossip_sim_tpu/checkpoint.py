@@ -22,7 +22,7 @@ from .engine.params import EngineParams
 
 log = logging.getLogger(__name__)
 
-_FORMAT_VERSION = 8
+_FORMAT_VERSION = 9
 # v1 checkpoints predate the tfail/rc_shi/rc_slo SimState fields; all three
 # are derivable from active/failed/rc_src plus the cluster stake table, so
 # v1 files remain loadable when ``tables`` is passed to restore_sim_state.
@@ -62,8 +62,15 @@ _FORMAT_VERSION = 8
 # so every plane backfills as zeros — exact, because the gated-off engine
 # carries the planes as identical zeros.  The committed v1-v7 fixtures in
 # tests/fixtures/checkpoints pin that forward-compat contract forever
-# (tests/test_checkpoint.py).
-_READABLE_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8)
+# (tests/test_checkpoint.py).  v9 adds the sparse frontier engine
+# (engine/sparse.py): a ``repr`` meta block recording the
+# ``representation`` compile key the state evolved under.  No new arrays —
+# but sparse-written files carry zero-width ``[O, N, 0]`` rc_shi/rc_slo
+# planes (the sparse round derives them from the cluster stake table), so
+# restore_sim_state re-derives full planes via ``tables`` when resuming
+# dense, and conversely collapses stored full planes to zero-width when
+# resuming sparse.  Pre-v9 files backfill representation "dense".
+_READABLE_VERSIONS = (1, 2, 3, 4, 5, 6, 7, 8, 9)
 
 # EngineParams fields that define array shapes; a mismatch makes the stored
 # state unusable under the new compile geometry.
@@ -114,6 +121,14 @@ _HEALTH_FIELDS = ("health",)
 _HEALTH_DEFAULTS = {f: EngineParams._field_defaults[f]
                     for f in _HEALTH_FIELDS}
 
+# EngineParams fields naming the engine representation (v9 meta block);
+# the key is static (a compile-geometry choice, params.py), and both
+# representations produce bit-identical states, so a resume may switch —
+# restore_sim_state reshapes the derived rc stake planes to match.
+_REPR_FIELDS = ("representation",)
+_REPR_DEFAULTS = {f: EngineParams._field_defaults[f]
+                  for f in _REPR_FIELDS}
+
 
 def save_state(path: str, state, params, config=None,
                iteration: int = 0, resilience: dict | None = None,
@@ -145,6 +160,9 @@ def save_state(path: str, state, params, config=None,
         # v8: the node-health observatory gate (obs/health.py)
         "health": {f: pdict.get(f, _HEALTH_DEFAULTS[f])
                    for f in _HEALTH_FIELDS},
+        # v9: the engine representation compile key (engine/sparse.py)
+        "repr": {f: pdict.get(f, _REPR_DEFAULTS[f])
+                 for f in _REPR_FIELDS},
         "iteration": int(iteration),
         # v5: journal cross-reference (resilience.py) — {} for plain
         # single-run checkpoints with no journal alongside
@@ -204,6 +222,7 @@ def load_state(path: str, params=None, expect_kind=None):
     meta.setdefault("traffic", dict(_TRAFFIC_DEFAULTS))
     meta.setdefault("adaptive", dict(_ADAPTIVE_DEFAULTS))
     meta.setdefault("health", dict(_HEALTH_DEFAULTS))
+    meta.setdefault("repr", dict(_REPR_DEFAULTS))
     meta.setdefault("kind", "sim")
     if expect_kind is not None and meta["kind"] != expect_kind:
         hint = ("restore_traffic_state / the --traffic-values run path"
@@ -261,6 +280,16 @@ def load_state(path: str, params=None, expect_kind=None):
                     "under an enabled gate",
                     f, getattr(params, f, _HEALTH_DEFAULTS[f]),
                     meta["health"][f])
+        for f in _REPR_FIELDS:
+            if (getattr(params, f, _REPR_DEFAULTS[f])
+                    != meta["repr"][f]):
+                log.info(
+                    "resuming with %s=%s but checkpoint was written with %s "
+                    "— both representations are bit-identical, so the "
+                    "continuation is exact; the rc stake planes are "
+                    "re-derived to match the new shape",
+                    f, getattr(params, f, _REPR_DEFAULTS[f]),
+                    meta["repr"][f])
     return arrays, stored, meta
 
 
@@ -301,6 +330,29 @@ def restore_sim_state(path: str, params=None, tables=None):
         o, n = arrays["failed"].shape
         for f in missing & health_fields:
             arrays[f] = np.zeros((o, n), np.int32)
+        missing = set(SimState._fields) - set(arrays)
+    # v9 representation switch: the sparse round carries the rc stake
+    # planes as zero-width [O, N, 0] arrays (derived from the cluster
+    # stake table each round), so the planes stored in the file may not
+    # match the shape the CURRENT representation expects.  Resuming
+    # sparse: collapse whatever is stored to zero-width.  Resuming dense
+    # from a sparse-written file: drop the zero-width planes and let the
+    # derivation below rebuild them from ``tables``.
+    target_repr = (getattr(params, "representation", None)
+                   if params is not None else None)
+    if target_repr is None:
+        target_repr = stored.get("representation", "dense")
+    if target_repr == "sparse":
+        o, _ = arrays["failed"].shape
+        n = stored["num_nodes"]
+        arrays["rc_shi"] = np.zeros((o, n, 0), np.int32)
+        arrays["rc_slo"] = np.zeros((o, n, 0), np.int32)
+        missing = set(SimState._fields) - set(arrays)
+    else:
+        for f in ("rc_shi", "rc_slo"):
+            if f in arrays and arrays[f].ndim == 3 \
+                    and arrays[f].shape[-1] == 0:
+                del arrays[f]
         missing = set(SimState._fields) - set(arrays)
     derivable = {"tfail", "rc_shi", "rc_slo"}
     if missing and missing <= derivable and tables is not None:

@@ -78,6 +78,11 @@ def _warn_shape_truncation(rows, params) -> tuple[int, int]:
     dropped = int(np.asarray(rows["inb_dropped"]).sum())
     overflow = int(np.asarray(rows["rc_overflow"]).sum())
     clamped = int(np.asarray(rows.get("hop_clamped", 0)).sum())
+    # total entries received into the cache path: every delivered message is
+    # one (src, score) candidate entry per destination.  Summed over nodes,
+    # the engine's per-target ingress equals the delivered count, so both
+    # the per-round rows and the all-origins aggregate can supply it.
+    received = int(np.asarray(rows.get("delivered", 0)).sum())
     if clamped:
         log.warning(
             "WARNING: %s hop sample(s) reached the top on-device histogram "
@@ -91,10 +96,12 @@ def _warn_shape_truncation(rows, params) -> tuple[int, int]:
             "results may diverge from the reference semantics. Raise "
             "EngineParams.inbound_cap.", dropped, params.k_inbound)
     if overflow:
+        pct = (f" ({100.0 * overflow / received:.2f}% of the {received} "
+               f"entries received)" if received > 0 else "")
         log.warning(
-            "WARNING: %s received-cache entries exceeded rc_slots=%s and "
+            "WARNING: %s received-cache entries%s exceeded rc_slots=%s and "
             "were evicted early — prune decisions may diverge. Raise "
-            "EngineParams.rc_slots.", overflow, params.rc_slots)
+            "EngineParams.rc_slots.", overflow, pct, params.rc_slots)
     return dropped, overflow
 
 
@@ -212,6 +219,7 @@ def _engine_params(config, num_nodes: int):
                        if config.test_type == Testing.FAIL_NODES else 0.0),
         trace_prune_cap=config.trace_prune_cap,
         health=config.health,
+        representation=config.engine_representation,
         **_impair_params(config),
         **_pull_params(config),
         **_traffic_params(config),
@@ -492,6 +500,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--health-topk", type=int, default=10,
                    help="hot nodes extracted per health digest metric "
                         "(the [k,·] harvest; --health only)")
+    p.add_argument("--engine-representation", default="dense",
+                   choices=["dense", "sparse"],
+                   help="gossip-round execution layout (engine/sparse.py): "
+                        "dense keeps the full-width sort-routed round; "
+                        "sparse reroutes delivery/BFS/inbound ranking over "
+                        "the bounded candidate edge list (segment "
+                        "reductions + deterministic scatters) and derives "
+                        "the received-cache stake planes from the cluster "
+                        "tables instead of carrying two [O,N,C] arrays — "
+                        "bit-identical rows and state, roughly half the "
+                        "received-cache bytes, and the representation the "
+                        "capacity model prices past the dense all-origins "
+                        "wall (tools/capacity_report.py --representation "
+                        "sparse). Push mode only; traffic needs dense")
     p.add_argument("--trace-dir", default="", metavar="DIR",
                    help="flight recorder (obs/trace.py): capture per-round "
                         "protocol events (delivery edges + outcomes, first-"
@@ -671,6 +693,7 @@ def config_from_args(args) -> Config:
         capacity_harvest=args.capacity_harvest,
         health=args.health,
         health_topk=args.health_topk,
+        engine_representation=args.engine_representation,
         trace_dir=args.trace_dir,
         trace_origins=args.trace_origins,
         trace_prune_cap=args.trace_prune_cap,
@@ -2080,7 +2103,10 @@ def run_all_origins(config: Config, json_rpc_url: str, dp_queue=None,
             log.warning("WARNING: node-health digest not emitted (%s)", e)
     _warn_shape_truncation(
         {"inb_dropped": agg.inb_dropped, "rc_overflow": agg.rc_overflow,
-         "hop_clamped": agg.hop_clamped},
+         "hop_clamped": agg.hop_clamped,
+         # per-node ingress summed over nodes == total delivered entries,
+         # the denominator for the rc-overflow percentage
+         "delivered": int(agg.ingress.sum())},
         params)
     if config.print_stats:
         agg.print_all()

@@ -219,6 +219,15 @@ class Config:
                                     # every output bit-identical to today
     health_topk: int = 10           # hot nodes extracted per digest (the
                                     # [k,·] harvest; report + sim_node_health)
+    engine_representation: str = "dense"  # gossip-round execution layout
+                                    # (engine/sparse.py): "dense" keeps the
+                                    # full-width sort-routed round; "sparse"
+                                    # reroutes over the candidate edge list
+                                    # (segment reductions + scatters) and
+                                    # derives the rc stake planes from the
+                                    # cluster tables.  Bit-identical rows
+                                    # and state either way — sparse is the
+                                    # memory/scale representation
     compilation_cache_dir: str = ""  # persistent XLA compilation cache
                                     # (engine/cache.py): compiled
                                     # executables are reused across

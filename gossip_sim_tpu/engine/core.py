@@ -87,15 +87,32 @@ from .sampler import SamplerTables, build_sampler_tables
 
 INF = jnp.int32(1 << 20)   # unreached sentinel (maps to u64::MAX, gossip.rs:490)
 BIG = jnp.int32(0x7FFFFFFF)
+BIG64 = jnp.int64(1 << 62)  # i64 twin of the BIG sort-key sentinel
 # Node-id packing base for the shared i32 sort keys (peer*pack + owner).
 # Chosen per cluster: 16384 keeps the round-4 key layout for N < 16384, one
-# extra bit covers N up to MAX_NODES.  The binding constraint is
+# extra bit covers N up to MAX_NODES_I32.  The binding constraint is
 # ((N-1)*pack + N-1)*2 + 1 < 2^31 with pack = 2^ceil(log2(N)), which holds
 # through N = 32768 but collides with the BIG sentinel exactly there — so
-# the supported bound is 32767.  Beyond that the packed keys need i64 sorts
-# (TPU-emulated, ~2x cost); not implemented.
-MAX_NODES = 32767
+# the i32 bound is 32767.  Past it the peer*pack+owner keys (prune apply,
+# _lookup joins) switch to i64 sort keys (TPU-emulated sorts, ~2x cost;
+# exact same join semantics).  The inbound (hop << pb | src) keys stay i32
+# — their bound is hist_bins * pack < 2^31, checked per round_step call —
+# which caps the supported cluster at MAX_NODES = 2^24 (16.7M nodes, the
+# documented scale target) with the default hist_bins = 64.
+MAX_NODES_I32 = 32767
+MAX_NODES = 1 << 24
 PACK = 16384               # default packing base (clusters with N < 16384)
+
+#: Test hook: force the i64 sort-key paths even for clusters within the
+#: i32 bound (parity tests drive the same cluster through both key widths).
+#: NOT part of the jit compile key — call ``clear_compile_cache()`` after
+#: toggling or the cached i32 executable keeps serving.
+FORCE_I64_KEYS = False
+
+
+def _keys_need_i64(num_nodes: int) -> bool:
+    """True when the peer*pack+owner sort keys overflow i32 for this N."""
+    return num_nodes > MAX_NODES_I32 or FORCE_I64_KEYS
 
 
 def _pack_base(num_nodes: int) -> int:
@@ -162,8 +179,8 @@ def make_cluster_tables(stakes_lamports: np.ndarray) -> ClusterTables:
     stakes = np.asarray(stakes_lamports, dtype=np.int64)
     if stakes.shape[0] > MAX_NODES:
         raise ValueError(
-            f"engine packs node ids into i32 sort keys; num_nodes must be "
-            f"<= {MAX_NODES}, got {stakes.shape[0]}")
+            f"engine packs (hop << pb | node) inbound sort keys into i32; "
+            f"num_nodes must be <= {MAX_NODES}, got {stakes.shape[0]}")
     if not ((stakes >= 0).all() and (stakes < (1 << 62)).all()):
         raise ValueError("stakes must be in [0, 2^62)")
     buckets = stake_buckets_array(stakes.astype(np.uint64)).astype(np.int32)
@@ -216,11 +233,11 @@ def _lookup(table_vals: jax.Array, queries: jax.Array, n: int,
     headed by its (unique, always-present) table entry, whose payload is
     forward-filled through the run and routed back by original position.
 
-    PRECONDITION (fast path): table values must lie in [0, pack) — the
-    forward fill packs them as ``position*pack + value`` in i32 and recovers
-    them with ``% pack``; out-of-range values would be silently corrupted.
-    Current callers pass perm indices (< n <= pack) and 0/1 flags.  The
-    log-shift fallback (taken when W*pack > 2^31) has no such bound.
+    PRECONDITION: table values must lie in [0, pack) — the forward fill
+    packs them as ``position*pack + value`` (i32 when ``W*pack`` fits,
+    else i64) and recovers them with ``% pack``; out-of-range values would
+    be silently corrupted.  Current callers pass perm indices
+    (< n <= pack) and 0/1 flags.
     """
     O, M = queries.shape
     W = n + M
@@ -236,24 +253,20 @@ def _lookup(table_vals: jax.Array, queries: jax.Array, n: int,
             jnp.arange(M, dtype=jnp.int32)[None, :], (O, M))], axis=1)
     sk, sv, sp = lax.sort((keys, vals, pos), dimension=-1, num_keys=1)
     have = (sk & 1) == 0
-    if W * pack <= (1 << 31):
-        # forward fill via one packed cummax: a query's head is the nearest
-        # table entry to its left (its own value-run always starts with one)
+    # forward fill via one packed cummax: a query's head is the nearest
+    # table entry to its left (its own value-run always starts with one).
+    # i32 packing when the position*pack keys fit; the i64 twin (exact
+    # same fill, 64-bit keys) covers wide joins — e.g. the rotate join at
+    # W = N*(rot_tries+1) — and clusters past MAX_NODES_I32.
+    if W * pack <= (1 << 31) and not FORCE_I64_KEYS:
         iw = jnp.arange(W, dtype=jnp.int32)[None, :]
         packed = jnp.where(have, iw * pack + sv.astype(jnp.int32), -1)
         fill = lax.cummax(packed, axis=1) % pack
     else:
-        run = sk >> 1
-        fill = jnp.where(have, sv, 0)
-        sh = 1
-        while sh < W:
-            pk = jnp.pad(run, ((0, 0), (sh, 0)), constant_values=-1)[:, :W]
-            pf = jnp.pad(fill, ((0, 0), (sh, 0)))[:, :W]
-            ph = jnp.pad(have, ((0, 0), (sh, 0)))[:, :W]
-            take = (~have) & ph & (pk == run)
-            fill = jnp.where(take, pf, fill)
-            have = have | take
-            sh *= 2
+        iw = jnp.arange(W, dtype=jnp.int64)[None, :]
+        packed = jnp.where(have, iw * pack + sv.astype(jnp.int64),
+                           jnp.int64(-1))
+        fill = (lax.cummax(packed, axis=1) % pack).astype(jnp.int32)
     _, out = lax.sort((sp, fill.astype(jnp.int32)), dimension=-1, num_keys=1)
     return out[:, :M]
 
@@ -341,6 +354,10 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
     active = jnp.where((cnt > S)[..., None], buf[..., 1:], buf[..., :S])
 
     C, H = p.rc_slots, p.hist_bins
+    # sparse representation: the stake planes are derived from the cluster
+    # tables each round, so the carried arrays are zero-width (same pytree
+    # structure — checkpoints, ledgers and lanes stay shape-compatible)
+    Cs = 0 if p.representation == "sparse" else C
     zi = lambda shape: jnp.zeros(shape, jnp.int32)
     return SimState(
         key=okeys,
@@ -349,8 +366,8 @@ def init_state(key: jax.Array, tables: ClusterTables, origins: jax.Array,
         tfail=jnp.zeros((O, N, S), bool),
         rc_src=jnp.full((O, N, C), N, jnp.int32),
         rc_score=zi((O, N, C)),
-        rc_shi=zi((O, N, C)),
-        rc_slo=zi((O, N, C)),
+        rc_shi=zi((O, N, Cs)),
+        rc_slo=zi((O, N, Cs)),
         rc_upserts=zi((O, N)),
         failed=jnp.zeros((O, N), bool),
         egress_acc=zi((O, N)),
@@ -454,6 +471,32 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
     F = min(F, S)
     pack = _pack_base(N)
     pb = pack.bit_length() - 1          # node-id bits in shared sort keys
+    # Sparse frontier representation (engine/sparse.py): replaces the
+    # full-width cross-node sorts with edge-list segment reductions /
+    # scatters and derives the rc_shi/rc_slo planes from ClusterTables.
+    # Static compile key — with representation="dense" every branch below
+    # takes the reference arm and the compiled graph is unchanged.
+    sparse_mode = p.representation == "sparse"
+    if sparse_mode:
+        from . import sparse as _sparse
+        if trace:
+            raise ValueError(
+                "the flight recorder requires representation='dense' — "
+                "sparse rounds do not materialize the full-width edge "
+                "intermediates it captures")
+    if N > MAX_NODES_I32:
+        # the inbound (hop << pb | src) keys and slot-compaction keys stay
+        # i32 at every N; these bounds bind only past the i32 node cap
+        if H * pack >= (1 << 31):
+            raise ValueError(
+                f"inbound sort keys (hop << {pb} | src) overflow i32: "
+                f"hist_bins * pack = {H * pack} >= 2^31; reduce hist_bins "
+                f"(< {(1 << 31) // pack}) for num_nodes={N}")
+        if 2 * N * K >= (1 << 31):
+            raise ValueError(
+                f"inbound compaction keys overflow i32: 2*N*K = "
+                f"{2 * N * K} >= 2^31; reduce inbound_cap for "
+                f"num_nodes={N}")
     O = int(origins.shape[0])
     origins = origins.astype(jnp.int32)
     o1 = jnp.arange(O)
@@ -481,10 +524,14 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
                 kidx = jnp.clip(n_fail - 1, 0, N - 1)
                 kth = jnp.sort(r, axis=-1)[:, kidx][:, None]
                 f = f | (r <= kth)
-                # rebuild per-slot target-failed bits via sort-join (once)
+                # rebuild per-slot target-failed bits (sort-join; sparse:
+                # one row gather)
                 q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
-                tf = _lookup(f.astype(jnp.int32), q, N,
-                             pack).reshape(O, N, S) == 1
+                if sparse_mode:
+                    tf = jnp.take_along_axis(f, q, axis=1).reshape(O, N, S)
+                else:
+                    tf = _lookup(f.astype(jnp.int32), q, N,
+                                 pack).reshape(O, N, S) == 1
                 return f, tf & (state.active < N)
             failed, tfail = lax.cond((it == kn.fail_at) & (n_fail > 0),
                                      _fail, lambda ft: ft, (failed, tfail))
@@ -501,8 +548,13 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             rec_ev = hu64 < rate_threshold_arr(kn.churn_recover_rate, jnp)
             failed = jnp.where(failed, ~rec_ev[None, :], fail_ev[None, :])
             q = jnp.minimum(state.active, N - 1).reshape(O, N * S)
-            tfail = (_lookup(failed.astype(jnp.int32), q, N,
-                             pack).reshape(O, N, S) == 1) & (state.active < N)
+            if sparse_mode:
+                tfail = (jnp.take_along_axis(failed, q, axis=1)
+                         .reshape(O, N, S)) & (state.active < N)
+            else:
+                tfail = (_lookup(failed.astype(jnp.int32), q, N,
+                                 pack).reshape(O, N, S) == 1) \
+                    & (state.active < N)
 
     with jax.named_scope("round/verb1_push_targets"):
         # ---- verb 1: push targets (gossip.rs:494-615) -----------------------
@@ -566,13 +618,12 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             trace_code = t_code
 
     with jax.named_scope("round/bfs_propagate"):
-        # ---- BFS frontier relaxation: two 1-key sorts per hop ---------------
+        # ---- BFS frontier relaxation ----------------------------------------
         # Hop-1 seed: the origin's own targets are a tiny slice, so the loop
-        # starts at hop 1 and each iteration costs only edge-key perturbation +
-        # two 1-key sorts over the (static) edge/pseudo key base.
-        tgt2_base = jnp.concatenate(
-            [jnp.where(tgt < N, tgt * 2, BIG - 1).reshape(O, NF),
-             pseudo_t * 2 + 1], axis=1)                              # [O, NF+N]
+        # starts at hop 1.  Dense: two 1-key sorts per hop over the (static)
+        # edge/pseudo key base.  Sparse (engine/sparse.py): one segment_max
+        # per hop over the N*F candidate edge list — cost tracks live edges,
+        # not the node universe.
         org_tgts = tgt[o1[:, None], origins[:, None],
                        jnp.arange(F)[None, :]]                       # [O, F]
         dist0 = jnp.full((O, N), INF, jnp.int32).at[o1, origins].set(0)
@@ -581,23 +632,33 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             o1[:, None], org_tgts].set(True, mode="drop")
         reached1 = frontier1.at[o1, origins].set(True)
 
-        def bfs_body(carry):
-            frontier, reached, dist, h = carry
-            quiet = jnp.broadcast_to((~frontier)[:, :, None],
-                                     (O, N, F)).reshape(O, NF)
-            delta = jnp.concatenate(
-                [quiet.astype(jnp.int32), jnp.zeros((O, N), jnp.int32)], axis=1)
-            (s1,) = lax.sort((tgt2_base + delta,), dimension=-1, num_keys=1)
-            k2 = jnp.where(_boundary(s1 >> 1), s1, BIG)
-            (s2,) = lax.sort((k2,), dimension=-1, num_keys=1)
-            dense = s2[:, :N]                 # keys t*2 + (1 - any), t ascending
-            newly = ((dense & 1) == 0) & ~reached
-            dist = jnp.where(newly, h + 1, dist)
-            return (newly, reached | newly, dist, h + 1)
+        if sparse_mode:
+            reached, dist = _sparse.bfs_reach(
+                tgt, frontier1, reached1, dist0, N)
+        else:
+            tgt2_base = jnp.concatenate(
+                [jnp.where(tgt < N, tgt * 2, BIG - 1).reshape(O, NF),
+                 pseudo_t * 2 + 1], axis=1)                          # [O, NF+N]
 
-        _, reached, dist, _ = lax.while_loop(
-            lambda c: jnp.any(c[0]), bfs_body,
-            (frontier1, reached1, dist0, jnp.int32(1)))
+            def bfs_body(carry):
+                frontier, reached, dist, h = carry
+                quiet = jnp.broadcast_to((~frontier)[:, :, None],
+                                         (O, N, F)).reshape(O, NF)
+                delta = jnp.concatenate(
+                    [quiet.astype(jnp.int32), jnp.zeros((O, N), jnp.int32)],
+                    axis=1)
+                (s1,) = lax.sort((tgt2_base + delta,), dimension=-1,
+                                 num_keys=1)
+                k2 = jnp.where(_boundary(s1 >> 1), s1, BIG)
+                (s2,) = lax.sort((k2,), dimension=-1, num_keys=1)
+                dense = s2[:, :N]             # keys t*2 + (1 - any), t ascending
+                newly = ((dense & 1) == 0) & ~reached
+                dist = jnp.where(newly, h + 1, dist)
+                return (newly, reached | newly, dist, h + 1)
+
+            _, reached, dist, _ = lax.while_loop(
+                lambda c: jnp.any(c[0]), bfs_body,
+                (frontier1, reached1, dist0, jnp.int32(1)))
 
     with jax.named_scope("round/verb2_consume"):
         # ---- delivered edges + verb 2: consume (gossip.rs:618-653) ----------
@@ -616,60 +677,68 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
                           else zero_o)
 
         hop1 = jnp.minimum(dist + 1, H - 1)                          # [O,N] per src
-        # per-edge payloads, src-major (free broadcasts)
-        kv = ((hop1[:, :, None] << pb) | iota_n[:, :, None]).astype(jnp.int32)
-        kv = jnp.broadcast_to(kv, (O, N, F)).reshape(O, NF)
-        shi_e = jnp.broadcast_to(tables.shi[None, :N, None], (O, N, F)).reshape(O, NF)
-        slo_e = jnp.broadcast_to(tables.slo[None, :N, None], (O, N, F)).reshape(O, NF)
-        kd = jnp.where(delivered, tgt, N).reshape(O, NF)
-        # one pseudo-edge per target (ranks after real: kv = BIG)
-        kd_c = jnp.concatenate([kd, pseudo_t], axis=1)               # [O, M1]
-        kv_c = jnp.concatenate([kv, jnp.full((O, N), BIG)], axis=1)
-        shi_c = jnp.concatenate([shi_e, jnp.zeros((O, N), jnp.int32)], axis=1)
-        slo_c = jnp.concatenate([slo_e, jnp.zeros((O, N), jnp.int32)], axis=1)
-        # rank inbound by (hop, src index) — index order equals the reference's
-        # pubkey-string sort by NodeIndex construction (gossip.rs:638-645)
-        st_, skv, shi_s, slo_s = lax.sort(
-            (kd_c, kv_c, shi_c, slo_c), dimension=-1, num_keys=2)
-        rank = _rank_in_run(st_)
-        is_pseudo = (skv == BIG) & (st_ < N)
-        real = (skv != BIG) & (st_ < N)
+        if sparse_mode:
+            # engine/sparse.py: segment-sum ingress + scatter compaction
+            # over the delivered edge list; the stake payloads are never
+            # routed (derived from ClusterTables at the use sites)
+            inb, ingress_round, inb_dropped = _sparse.rank_inbound(
+                delivered, tgt, hop1, pb, pack, K, N)
+            inb_shi = inb_slo = None
+        else:
+          # per-edge payloads, src-major (free broadcasts)
+          kv = ((hop1[:, :, None] << pb) | iota_n[:, :, None]).astype(jnp.int32)
+          kv = jnp.broadcast_to(kv, (O, N, F)).reshape(O, NF)
+          shi_e = jnp.broadcast_to(tables.shi[None, :N, None], (O, N, F)).reshape(O, NF)
+          slo_e = jnp.broadcast_to(tables.slo[None, :N, None], (O, N, F)).reshape(O, NF)
+          kd = jnp.where(delivered, tgt, N).reshape(O, NF)
+          # one pseudo-edge per target (ranks after real: kv = BIG)
+          kd_c = jnp.concatenate([kd, pseudo_t], axis=1)             # [O, M1]
+          kv_c = jnp.concatenate([kv, jnp.full((O, N), BIG)], axis=1)
+          shi_c = jnp.concatenate([shi_e, jnp.zeros((O, N), jnp.int32)], axis=1)
+          slo_c = jnp.concatenate([slo_e, jnp.zeros((O, N), jnp.int32)], axis=1)
+          # rank inbound by (hop, src index) — index order equals the reference's
+          # pubkey-string sort by NodeIndex construction (gossip.rs:638-645)
+          st_, skv, shi_s, slo_s = lax.sort(
+              (kd_c, kv_c, shi_c, slo_c), dimension=-1, num_keys=2)
+          rank = _rank_in_run(st_)
+          is_pseudo = (skv == BIG) & (st_ < N)
+          real = (skv != BIG) & (st_ < N)
 
-        if trace:
-            # first-delivery sender per receiver: each target's run starts
-            # with its rank-0 entry — the minimum (hop, src) inbound edge
-            # when any exists, else the pseudo (kv == BIG).  One 1-key sort
-            # compacts the N rank-0 entries into target order.
-            fd_k = jnp.where((rank == 0) & (st_ < N), st_, BIG)
-            _, fd_kv = lax.sort((fd_k, skv), dimension=-1, num_keys=1)
-            fkv = fd_kv[:, :N]
-            trace_first = jnp.where(fkv != BIG, fkv & (pack - 1), -1)
+          if trace:
+              # first-delivery sender per receiver: each target's run starts
+              # with its rank-0 entry — the minimum (hop, src) inbound edge
+              # when any exists, else the pseudo (kv == BIG).  One 1-key sort
+              # compacts the N rank-0 entries into target order.
+              fd_k = jnp.where((rank == 0) & (st_ < N), st_, BIG)
+              _, fd_kv = lax.sort((fd_k, skv), dimension=-1, num_keys=1)
+              fkv = fd_kv[:, :N]
+              trace_first = jnp.where(fkv != BIG, fkv & (pack - 1), -1)
 
-        # ingress counts: the pseudo entry sorts last in its run, so its rank is
-        # the number of delivered edges into its target; compact runs -> [O, N].
-        ing_k = jnp.where(is_pseudo, st_, BIG)
-        _, ing_cnt = lax.sort((ing_k, rank), dimension=-1, num_keys=1)
-        ingress_round = ing_cnt[:, :N]                               # [O, N]
-        inb_dropped = jnp.sum(real & (rank >= K), axis=-1, dtype=jnp.int32)
+          # ingress counts: the pseudo entry sorts last in its run, so its rank
+          # is the number of delivered edges into its target; compact -> [O, N].
+          ing_k = jnp.where(is_pseudo, st_, BIG)
+          _, ing_cnt = lax.sort((ing_k, rank), dimension=-1, num_keys=1)
+          ingress_round = ing_cnt[:, :N]                             # [O, N]
+          inb_dropped = jnp.sum(real & (rank >= K), axis=-1, dtype=jnp.int32)
 
-        # inbound rows [O, N, K] via slot-aligned two-sort compaction
-        keep = real & (rank < K)
-        gk = jnp.where(keep, (st_ * K + rank) * 2, BIG)
-        slot_keys = jnp.broadcast_to(
-            jnp.arange(NK, dtype=jnp.int32)[None, :] * 2 + 1, (O, NK))
-        ga = jnp.concatenate([gk, slot_keys], axis=1)
-        kv_a = jnp.concatenate([skv, jnp.full((O, NK), BIG)], axis=1)
-        shi_a = jnp.concatenate([shi_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
-        slo_a = jnp.concatenate([slo_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
-        sA, kvA, hiA, loA = lax.sort((ga, kv_a, shi_a, slo_a),
-                                     dimension=-1, num_keys=1)
-        gB = jnp.where(_boundary(sA >> 1), sA, BIG)
-        sB, kvB, hiB, loB = lax.sort((gB, kvA, hiA, loA),
-                                     dimension=-1, num_keys=1)
-        inb_real = (sB[:, :NK] & 1) == 0
-        inb = jnp.where(inb_real, kvB[:, :NK] & (pack - 1), N).reshape(O, N, K)
-        inb_shi = jnp.where(inb_real, hiB[:, :NK], 0).reshape(O, N, K)
-        inb_slo = jnp.where(inb_real, loB[:, :NK], 0).reshape(O, N, K)
+          # inbound rows [O, N, K] via slot-aligned two-sort compaction
+          keep = real & (rank < K)
+          gk = jnp.where(keep, (st_ * K + rank) * 2, BIG)
+          slot_keys = jnp.broadcast_to(
+              jnp.arange(NK, dtype=jnp.int32)[None, :] * 2 + 1, (O, NK))
+          ga = jnp.concatenate([gk, slot_keys], axis=1)
+          kv_a = jnp.concatenate([skv, jnp.full((O, NK), BIG)], axis=1)
+          shi_a = jnp.concatenate([shi_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
+          slo_a = jnp.concatenate([slo_s, jnp.zeros((O, NK), jnp.int32)], axis=1)
+          sA, kvA, hiA, loA = lax.sort((ga, kv_a, shi_a, slo_a),
+                                       dimension=-1, num_keys=1)
+          gB = jnp.where(_boundary(sA >> 1), sA, BIG)
+          sB, kvB, hiB, loB = lax.sort((gB, kvA, hiA, loA),
+                                       dimension=-1, num_keys=1)
+          inb_real = (sB[:, :NK] & 1) == 0
+          inb = jnp.where(inb_real, kvB[:, :NK] & (pack - 1), N).reshape(O, N, K)
+          inb_shi = jnp.where(inb_real, hiB[:, :NK], 0).reshape(O, N, K)
+          inb_slo = jnp.where(inb_real, loB[:, :NK], 0).reshape(O, N, K)
 
     with jax.named_scope("round/rc_merge"):
         # ---- received-cache merge (received_cache.rs:83-98) -----------------
@@ -711,10 +780,18 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
              jnp.where(include, inb * 2 + 1, BIG)], axis=-1)         # [O,N,C+K]
         msc = jnp.concatenate(
             [rc_score, jnp.where(include, contrib, 0)], axis=-1)
-        mhi = jnp.concatenate([rc_shi, inb_shi], axis=-1)
-        mlo = jnp.concatenate([rc_slo, inb_slo], axis=-1)
-        mk_s, msc_s, mhi_s, mlo_s = lax.sort(
-            (mk, msc, mhi, mlo), dimension=-1, num_keys=1)
+        if sparse_mode:
+            # sparse carries no stake payloads through the merge — the
+            # rc_shi/rc_slo planes are zero-width and verb 3 derives the
+            # stakes from ClusterTables by rc_src gather (the carried-dense
+            # invariant rc_shi == shi[rc_src] holds by construction: every
+            # insert copies the table stake and the index-N pad is 0)
+            mk_s, msc_s = lax.sort((mk, msc), dimension=-1, num_keys=1)
+        else:
+            mhi = jnp.concatenate([rc_shi, inb_shi], axis=-1)
+            mlo = jnp.concatenate([rc_slo, inb_slo], axis=-1)
+            mk_s, msc_s, mhi_s, mlo_s = lax.sort(
+                (mk, msc, mhi, mlo), dimension=-1, num_keys=1)
         is_dup = jnp.concatenate(
             [jnp.zeros((O, N, 1), bool),
              ((mk_s[..., 1:] >> 1) == (mk_s[..., :-1] >> 1))
@@ -726,15 +803,19 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
         msc_s = msc_s + jnp.where(nxt_dup, nxt_sc, 0)                # bump old
         valid_m = (mk_s != BIG) & ~is_dup
         ck = jnp.where(valid_m, mk_s >> 1, BIG)
-        ck_s, csc, chi, clo = lax.sort(
-            (ck, msc_s, mhi_s, mlo_s), dimension=-1, num_keys=1)
+        if sparse_mode:
+            ck_s, csc = lax.sort((ck, msc_s), dimension=-1, num_keys=1)
+        else:
+            ck_s, csc, chi, clo = lax.sort(
+                (ck, msc_s, mhi_s, mlo_s), dimension=-1, num_keys=1)
         n_valid = jnp.sum(valid_m, axis=-1, dtype=jnp.int32)
         rc_overflow = jnp.sum(jnp.maximum(n_valid - C, 0), axis=(-1,),
                               dtype=jnp.int32)
         rc_src = jnp.where(ck_s[..., :C] != BIG, ck_s[..., :C], N)
         rc_score = jnp.where(ck_s[..., :C] != BIG, csc[..., :C], 0)
-        rc_shi = jnp.where(ck_s[..., :C] != BIG, chi[..., :C], 0)
-        rc_slo = jnp.where(ck_s[..., :C] != BIG, clo[..., :C], 0)
+        if not sparse_mode:
+            rc_shi = jnp.where(ck_s[..., :C] != BIG, chi[..., :C], 0)
+            rc_slo = jnp.where(ck_s[..., :C] != BIG, clo[..., :C], 0)
 
         any_inb = inb[..., 0] < N  # a rank-0 record is one upsert (received_cache.rs:85-87)
         rc_ups = state.rc_upserts + any_inb.astype(jnp.int32)
@@ -751,13 +832,21 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
                              * kn.prune_stake_threshold).astype(jnp.int64)
 
         member = rc_src < N
+        if sparse_mode:
+            # derive the stake planes from the cluster tables (the planes
+            # the dense round carries equal shi/slo[rc_src] exactly; the
+            # index-N pad is 0, matching empty slots)
+            rc_shi_v = tables.shi[rc_src]
+            rc_slo_v = tables.slo[rc_src]
+        else:
+            rc_shi_v, rc_slo_v = rc_shi, rc_slo
         mx = jnp.iinfo(jnp.int32).max
         neg_score = jnp.where(member, -rc_score, mx)
-        neg_hi = jnp.where(member, -rc_shi, mx)
-        neg_lo = jnp.where(member, -rc_slo, mx)
+        neg_hi = jnp.where(member, -rc_shi_v, mx)
+        neg_lo = jnp.where(member, -rc_slo_v, mx)
         # (score desc, stake desc, src asc): stake split keeps i64 out of the sort
         _, _, _, src_sorted, hi_sorted, lo_sorted = lax.sort(
-            (neg_score, neg_hi, neg_lo, rc_src, rc_shi, rc_slo),
+            (neg_score, neg_hi, neg_lo, rc_src, rc_shi_v, rc_slo_v),
             dimension=-1, num_keys=4)
         memb_sorted = src_sorted < N
         stake_sorted = (hi_sorted.astype(jnp.int64) << 31) | lo_sorted.astype(
@@ -814,17 +903,23 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
         t_rows = jnp.broadcast_to(iota_n[:, :, None], (O, N, C))
         pair_live = pk_s < C
 
-        edge_keys = (jnp.minimum(peer, N - 1) * pack
+        # peer*pack+owner overflows i32 past MAX_NODES_I32 — the shared
+        # match keys switch to i64 there (same join, wider sort keys)
+        kdt = jnp.int64 if _keys_need_i64(N) else jnp.int32
+        kbig = BIG64 if _keys_need_i64(N) else BIG
+        edge_keys = (jnp.minimum(peer, N - 1).astype(kdt) * pack
                      + iota_n[:, :, None]).reshape(O, N * S)
-        edge_keys = jnp.where(is_peer.reshape(O, N * S), edge_keys * 2 + 1, BIG)
+        edge_keys = jnp.where(is_peer.reshape(O, N * S), edge_keys * 2 + 1,
+                              kbig)
         edge_pos = jnp.broadcast_to(
             jnp.arange(N * S, dtype=jnp.int32)[None, :], (O, N * S))
 
         def _apply(np_slots):
             pair_keys = jnp.where(
                 pair_live[..., :np_slots],
-                (t_rows[..., :np_slots] * pack + psrc_s[..., :np_slots]) * 2,
-                BIG).reshape(O, N * np_slots)
+                (t_rows[..., :np_slots].astype(kdt) * pack
+                 + psrc_s[..., :np_slots]) * 2,
+                kbig).reshape(O, N * np_slots)
             # pair key = pruner*pack + prunee; edge key = peer*pack + owner:
             # a hit means this slot's peer has pruned the owner for this origin
             k = jnp.concatenate([edge_keys, pair_keys], axis=1)
@@ -864,9 +959,14 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
         )(subs[:, 2:2 + T])                                          # [O, T, N, 2]
         u_all = jnp.moveaxis(u_all, 1, 2)                            # [O, N, T, 2]
         members = _sample_fast(tables, origins, u_all[..., 0], u_all[..., 1])
-        perm_t = jnp.broadcast_to(tables.sampler.perm[None, :], (O, N))
-        cands = _lookup(perm_t, members.reshape(O, N * T), N,
-                        pack).reshape(O, N, T)
+        if sparse_mode:
+            # class-position -> node-id translation as a direct table
+            # gather (the sort-join below computes exactly perm[members])
+            cands = tables.sampler.perm[members]
+        else:
+            perm_t = jnp.broadcast_to(tables.sampler.perm[None, :], (O, N))
+            cands = _lookup(perm_t, members.reshape(O, N * T), N,
+                            pack).reshape(O, N, T)
 
         chosen = jnp.full((O, N), N, jnp.int32)
         found_new = jnp.zeros((O, N), bool)
@@ -881,8 +981,13 @@ def round_step(params, tables: ClusterTables, origins: jax.Array,
             found_new = found_new | ok
         do_rot = rotate & found_new
         rot_failed = jnp.sum(rotate & ~found_new, axis=-1, dtype=jnp.int32)
-        chosen_failed = _lookup(
-            failed.astype(jnp.int32), jnp.minimum(chosen, N - 1), N, pack) == 1
+        if sparse_mode:
+            chosen_failed = jnp.take_along_axis(
+                failed, jnp.minimum(chosen, N - 1), axis=1)
+        else:
+            chosen_failed = _lookup(
+                failed.astype(jnp.int32), jnp.minimum(chosen, N - 1), N,
+                pack) == 1
 
         mcnt = jnp.sum(active_now < N, axis=-1, dtype=jnp.int32)
         full_row = mcnt >= S

@@ -159,6 +159,15 @@ class EngineStatic(NamedTuple):
     # trace/traffic gates (parity snapshots and deterministic Influx
     # wire lines are byte-identical with the gate off).
     health: bool = False
+    # Round-representation selector (engine/sparse.py): "dense" compiles
+    # the reference full-width [O,N]-plane sort graph, bit-identical to a
+    # build without the key.  "sparse" compiles the frontier/edge-list
+    # round: segment-sum routing over the O(N*fanout) candidate edges,
+    # scatter compaction into the inbound ranking, and the rc_shi/rc_slo
+    # received-cache planes derived from ClusterTables instead of carried
+    # (state keeps them as zero-width [O,N,0] arrays).  Static gate —
+    # each value is its own executable; the outputs are bit-exact.
+    representation: str = "dense"
 
     @property
     def num_buckets(self) -> int:
@@ -351,6 +360,13 @@ class EngineParams(NamedTuple):
                             # jitted round scan.  Static gate — off, the
                             # compiled round carries zero health code and
                             # every output is bit-identical to today.
+    representation: str = "dense"  # round representation (engine/sparse.py):
+                            # "dense" = the reference full-width sort graph
+                            # (bit-identical to a build without the key);
+                            # "sparse" = frontier/edge-list segment-sum
+                            # routing with the rc_shi/rc_slo planes derived
+                            # from ClusterTables instead of carried — same
+                            # bits, ~half the received-cache memory.
 
     @property
     def num_buckets(self) -> int:
@@ -430,6 +446,7 @@ class EngineParams(NamedTuple):
             pull_slots=self.pull_slots_resolved if self.has_pull else 0,
             traffic_slots=self.traffic_values if self.has_traffic else 0,
             health=self.health,
+            representation=self.representation,
         )
 
     def knob_values(self) -> EngineKnobs:
@@ -485,6 +502,15 @@ class EngineParams(NamedTuple):
         assert self.gossip_mode in ("push", "pull", "push-pull",
                                     "adaptive"), (
             f"unknown gossip_mode: {self.gossip_mode!r}")
+        assert self.representation in ("dense", "sparse"), (
+            f"unknown representation: {self.representation!r}")
+        if self.representation == "sparse":
+            assert self.gossip_mode == "push", (
+                "the sparse frontier round implements the push phase only; "
+                "pull/adaptive modes need the dense representation")
+            assert not self.has_traffic, (
+                "the sparse frontier round does not carry the traffic "
+                "subsystem yet; use representation='dense' with traffic")
         if self.gossip_mode == "adaptive":
             assert 0.0 < self.adaptive_switch_threshold <= 1.0, (
                 "adaptive_switch_threshold must be in (0, 1]")

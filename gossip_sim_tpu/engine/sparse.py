@@ -1,0 +1,149 @@
+"""Sparse frontier kernels for the gossip round (representation="sparse").
+
+The dense engine (core.py) expresses every cross-node data movement as a
+full-width sort: BFS relaxation is two ``[O, N*F + N]`` sorts per hop, the
+inbound ranking is a pair of 4-wide sorts over ``N*F + N + N*K`` elements,
+and the received cache carries four ``[O, N, C]`` planes.  That shape is
+what the capacity observatory (obs/capacity.py) measured as the 16 GB
+all-origins wall at N ≈ 3.9k — the ``rc_*`` planes dominate the ledger and
+the sort workspaces dominate the XLA temp bytes.
+
+The sparse representation (selected by the static
+``EngineStatic.representation`` compile key) reroutes the round over the
+bounded candidate edge list — at most ``N * push_fanout`` live edges per
+origin — using segment reductions and deterministic scatters:
+
+* **BFS propagation** (:func:`bfs_reach`): per hop, each candidate edge
+  carries its source's frontier bit to its target through ONE
+  ``segment_max`` over the edge list (segment id = target, per origin).
+  Cost tracks live edges, not the ``N + N*F`` sort width, and no payload
+  planes ride along.
+* **Inbound ranking** (:func:`rank_inbound`): ingress counts are a single
+  ``segment_sum`` over delivered edges; the top-K inbound compaction keeps
+  the reference (hop, src)-rank sort but drops both stake payload planes
+  and replaces the dense slot-alignment double sort with one deterministic
+  scatter (unique (target, rank) indices).
+* **Received cache**: the ``rc_shi``/``rc_slo`` stake planes are never
+  carried — ``SimState`` holds them as zero-width ``[O, N, 0]`` arrays and
+  verb 3 derives them as ``tables.shi[rc_src]`` / ``tables.slo[rc_src]``.
+  This is exact, not approximate: every dense insert copies the table
+  stake for its source and the index-N pad is 0 (matching empty slots), so
+  the carried planes always equal the gather.  Two of the four ``[O, N, C]``
+  planes vanish from the ledger — the received-cache bytes halve.
+* **Table joins**: the ``_lookup`` sort-joins (tfail rebuild, rotation
+  candidate translation) become direct row gathers — on the sparse path
+  gathers beat sorting the whole table width through every query.
+
+Everything else (verb 1 slot selection, the rc merge scan, prune
+decide/apply, rotation, stats) is shared with the dense round in
+``core.round_step`` — the sparse arms are selected per site, so the two
+representations produce bit-identical states and rows by construction,
+and ``representation="dense"`` compiles a graph with no sparse code in it
+(the gate ``tools/sparse_smoke.py`` enforces both directions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# BIG and the rank helper are shared with the dense kernels; resolved
+# lazily because core imports this module inside round_step.
+
+def _big():
+    from .core import BIG
+    return BIG
+
+
+def _rank_in_run(run_of):
+    from .core import _rank_in_run as rir
+    return rir(run_of)
+
+
+def bfs_reach(tgt: jax.Array, frontier1: jax.Array, reached1: jax.Array,
+              dist0: jax.Array, n: int):
+    """Frontier relaxation over the candidate edge list via segment_max.
+
+    ``tgt``: [O, N, F] i32 candidate delivery targets (n = no delivery);
+    ``frontier1``/``reached1``/``dist0``: the hop-1 seed (origin's own
+    targets), exactly as the dense BFS builds it.  Returns
+    ``(reached, dist)`` — bit-identical to the dense two-sort relaxation:
+    per hop every edge whose source sits on the frontier raises its
+    target's "any inbound" bit; empty segments come back at the i32
+    minimum, which never passes the ``> 0`` test.
+    """
+    O, N, F = tgt.shape
+    assert N == n
+    seg = (jnp.where(tgt < n, tgt, n)
+           + (jnp.arange(O, dtype=jnp.int32) * (n + 1))[:, None, None])
+    seg_flat = seg.reshape(-1)
+
+    def body(carry):
+        frontier, reached, dist, h = carry
+        val = jnp.broadcast_to(frontier[:, :, None],
+                               tgt.shape).astype(jnp.int32).reshape(-1)
+        got = jax.ops.segment_max(val, seg_flat,
+                                  num_segments=O * (n + 1))
+        newly = (got.reshape(O, n + 1)[:, :n] > 0) & ~reached
+        dist = jnp.where(newly, h + 1, dist)
+        return (newly, reached | newly, dist, h + 1)
+
+    _, reached, dist, _ = lax.while_loop(
+        lambda c: jnp.any(c[0]), body,
+        (frontier1, reached1, dist0, jnp.int32(1)))
+    return reached, dist
+
+
+def rank_inbound(delivered: jax.Array, tgt: jax.Array, hop1: jax.Array,
+                 pb: int, pack: int, k: int, n: int):
+    """Top-K inbound compaction + ingress counts over delivered edges.
+
+    ``delivered``: [O, N, F] bool delivered-edge mask; ``tgt`` the targets;
+    ``hop1`` [O, N] the per-source delivery hop.  Returns
+    ``(inb, ingress_round, inb_dropped)`` with ``inb``: [O, N, K] i32
+    inbound source per rank (n = empty), bit-identical to the dense
+    double-sort compaction:
+
+    * ranks come from the same (target, hop << pb | src) sort — index
+      order equals the reference's pubkey sort by NodeIndex construction
+      (gossip.rs:638-645) — but with no stake payload planes riding along;
+    * the [O, N, K] slot alignment is ONE deterministic scatter (each kept
+      edge owns the unique slot ``target*K + rank``) instead of the dense
+      two-sort round trip over ``N*F + N*K`` elements;
+    * ingress counts are a ``segment_sum`` over delivered edges, and the
+      truncation count is ``sum(max(ingress - K, 0))`` — the same value
+      the dense rank >= K census produces.
+    """
+    BIG = _big()
+    O, N, F = tgt.shape
+    NF = N * F
+    iota_n = jnp.arange(N, dtype=jnp.int32)[None, :]
+
+    # ingress via one segment_sum over the delivered edge list
+    seg = (jnp.where(delivered, tgt, n)
+           + (jnp.arange(O, dtype=jnp.int32) * (n + 1))[:, None, None])
+    ingress_round = jax.ops.segment_sum(
+        delivered.astype(jnp.int32).reshape(-1), seg.reshape(-1),
+        num_segments=O * (n + 1)).reshape(O, n + 1)[:, :n]
+    inb_dropped = jnp.sum(jnp.maximum(ingress_round - k, 0), axis=-1,
+                          dtype=jnp.int32)
+
+    # rank by (target, hop << pb | src); undelivered edges key at target n
+    # and sort to the tail of the row, outside every real run
+    kv = ((hop1[:, :, None] << pb) | iota_n[:, :, None]).astype(jnp.int32)
+    kv = jnp.broadcast_to(kv, (O, N, F)).reshape(O, NF)
+    kd = jnp.where(delivered, tgt, n).reshape(O, NF)
+    st_, skv = lax.sort((kd, kv), dimension=-1, num_keys=2)
+    rank = _rank_in_run(st_)
+    keep = (st_ < n) & (rank < k)
+
+    # deterministic scatter: kept edges own unique slots target*K + rank;
+    # everything else aims one past the buffer and mode="drop" discards it
+    rows = jnp.broadcast_to(jnp.arange(O, dtype=jnp.int32)[:, None],
+                            (O, NF))
+    idx = jnp.where(keep, st_ * k + rank, n * k)
+    buf = jnp.full((O, n * k), BIG, jnp.int32)
+    buf = buf.at[rows, idx].set(skv, mode="drop")
+    inb = jnp.where(buf != BIG, buf & (pack - 1), n).reshape(O, n, k)
+    return inb, ingress_round, inb_dropped

@@ -111,18 +111,30 @@ def sim_state_entries(params, origin_batch: int = 1,
                   params.hist_bins)
     O = int(origin_batch)
     od = 1 if origins_scale_with_n else 0   # the O axis tracks N?
+    sparse = getattr(params, "representation", "dense") == "sparse"
     e = _entry
+    # Sparse representation (engine/sparse.py): the received-cache stake
+    # planes are derived from ClusterTables each round, so the carried
+    # arrays are zero-width [O, N, 0] — exactly 0 bytes, and the cache
+    # entries move to the "sparse" ledger group so fit-budget projections
+    # price the representation switch.
+    rc_group = "sparse" if sparse else "received-cache"
+    Cs = 0 if sparse else C
+    rc_pf = "O*N*0*4 (derived: tables.shi/slo[rc_src])" if sparse \
+        else "O*N*C*4"
     return [
         e("key", "core", (O, 2), "uint32", "O*2*4", od),
         e("active", "active-set", (O, N, S), "int32", "O*N*S*4", 1 + od),
         e("pruned", "active-set", (O, N, S), "bool", "O*N*S*1", 1 + od),
         e("tfail", "active-set", (O, N, S), "bool", "O*N*S*1", 1 + od),
-        e("rc_src", "received-cache", (O, N, C), "int32", "O*N*C*4", 1 + od),
-        e("rc_score", "received-cache", (O, N, C), "int32", "O*N*C*4",
+        e("rc_src", rc_group, (O, N, C), "int32", "O*N*C*4", 1 + od),
+        e("rc_score", rc_group, (O, N, C), "int32", "O*N*C*4",
           1 + od),
-        e("rc_shi", "received-cache", (O, N, C), "int32", "O*N*C*4", 1 + od),
-        e("rc_slo", "received-cache", (O, N, C), "int32", "O*N*C*4", 1 + od),
-        e("rc_upserts", "received-cache", (O, N), "int32", "O*N*4", 1 + od),
+        e("rc_shi", rc_group, (O, N, Cs), "int32", rc_pf,
+          od if sparse else 1 + od),
+        e("rc_slo", rc_group, (O, N, Cs), "int32", rc_pf,
+          od if sparse else 1 + od),
+        e("rc_upserts", rc_group, (O, N), "int32", "O*N*4", 1 + od),
         e("failed", "core", (O, N), "bool", "O*N*1", 1 + od),
         e("egress_acc", "stats", (O, N), "int32", "O*N*4", 1 + od),
         e("ingress_acc", "stats", (O, N), "int32", "O*N*4", 1 + od),

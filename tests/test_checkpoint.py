@@ -113,7 +113,7 @@ def test_v3_checkpoint_records_impair_block(tmp_path):
     path = str(tmp_path / "ckpt.npz")
     save_state(path, state, params, iteration=4)
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 8
+    assert meta["format_version"] == 9
     assert meta["impair"] == {
         "packet_loss_rate": 0.25, "churn_fail_rate": 0.01,
         "churn_recover_rate": 0.5, "partition_at": 3, "heal_at": 8,
@@ -294,6 +294,8 @@ def test_checkpoint_forward_compat_matrix(version):
     assert not np.asarray(restored.health_prune_recv).any()
     assert not np.asarray(restored.health_first_round).any()
     assert meta["health"]["health"] is False
+    # pre-v9 backfill: every earlier era wrote the dense representation
+    assert meta["repr"]["representation"] == "dense"
     # the restored state must continue on the current engine
     origins = jnp.arange(1, dtype=jnp.int32)
     state, rows = run_rounds(params, tables, origins, restored, 2,
@@ -308,7 +310,7 @@ def test_v5_checkpoint_records_resilience_block(tmp_path):
     save_state(path, state, params, iteration=2,
                resilience={"journal": "ckpt.journal", "committed_units": 3})
     _, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 8
+    assert meta["format_version"] == 9
     assert meta["resilience"] == {"journal": "ckpt.journal",
                                   "committed_units": 3}
 
@@ -368,7 +370,7 @@ def test_v6_traffic_checkpoint_roundtrip_and_kind_guard(tmp_path):
                        traffic_stats=stats_state)
     restored, stored, meta = restore_traffic_state(path, tparams)
     assert meta["kind"] == "traffic"
-    assert meta["format_version"] == 8
+    assert meta["format_version"] == 9
     assert meta["traffic"]["traffic_values"] == 3
     assert meta["traffic_stats"]["iterations"] == [0, 1, 2]
     for f, a, b in zip(restored._fields, restored, tstate):
@@ -400,7 +402,7 @@ def test_v8_checkpoint_roundtrips_nonzero_health_planes(tmp_path):
     path = str(tmp_path / "v8.npz")
     save_state(path, state, params, iteration=4)
     restored, _, meta = restore_sim_state(path, params)
-    assert meta["format_version"] == 8
+    assert meta["format_version"] == 9
     assert meta["health"] == {"health": True}
     np.testing.assert_array_equal(np.asarray(restored.health_prune_recv),
                                   np.asarray(state.health_prune_recv))
@@ -455,3 +457,47 @@ def test_health_gate_mismatch_warns_on_resume(tmp_path, caplog):
     with caplog.at_level(logging.WARNING):
         restore_sim_state(path, params._replace(health=True))
     assert any("health planes" in r.message for r in caplog.records)
+
+
+@pytest.mark.parametrize("write_repr,read_repr",
+                         [("dense", "sparse"), ("sparse", "dense")])
+def test_v9_cross_representation_resume_bit_identical(
+        tmp_path, write_repr, read_repr):
+    """v9 stamps the representation and restore_sim_state reshapes the rc
+    stake planes to the CURRENT params (collapse to [O,N,0] for sparse;
+    re-derive via the cluster tables for dense): a checkpoint written
+    under either representation must continue bit-identically to a
+    never-checkpointed run under the other."""
+    n, o = 48, 2
+    rng = np.random.default_rng(3)
+    stakes = rng.integers(1, 1 << 16, n).astype(np.int64) * 10**9
+    tables = make_cluster_tables(stakes)
+    origins = jnp.arange(o, dtype=jnp.int32)
+
+    def params_for(r):
+        return EngineParams(num_nodes=n, warm_up_rounds=0,
+                            representation=r).validate()
+
+    wp = params_for(write_repr)
+    state = init_state(jax.random.PRNGKey(3), tables, origins, wp)
+    state, _ = run_rounds(wp, tables, origins, state, 3)
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, state, wp, iteration=3)
+
+    rp = params_for(read_repr)
+    restored, _, meta = restore_sim_state(path, rp, tables)
+    assert meta["format_version"] == 9
+    assert meta["repr"]["representation"] == write_repr
+    width = 0 if read_repr == "sparse" \
+        else np.asarray(state.rc_src).shape[-1]
+    assert np.asarray(restored.rc_shi).shape[-1] == width
+    _, rows = run_rounds(rp, tables, origins, restored, 3, start_it=3,
+                         detail=True)
+
+    ref = init_state(jax.random.PRNGKey(3), tables, origins, rp)
+    ref, _ = run_rounds(rp, tables, origins, ref, 3)
+    _, ref_rows = run_rounds(rp, tables, origins, ref, 3, start_it=3,
+                             detail=True)
+    for k in ref_rows:
+        np.testing.assert_array_equal(
+            np.asarray(rows[k]), np.asarray(ref_rows[k]), err_msg=k)

@@ -322,3 +322,34 @@ def test_unimpaired_run_has_no_delivery_stats(backend):
     s = coll.collection[0]
     assert not s.has_delivery_stats()
     assert s.recovery_iterations is None
+
+
+def test_rc_overflow_warning_reports_percentage(caplog):
+    """The rc-overflow warning must quantify the truncation: the count
+    alone cannot tell a harmless blip from systematic divergence, so the
+    message carries overflow as a percentage of all entries received
+    (sum of per-round delivered counts == per-target cache ingress)."""
+    import logging
+
+    from gossip_sim_tpu.cli import _warn_shape_truncation
+    from gossip_sim_tpu.engine import EngineParams
+
+    params = EngineParams(num_nodes=100)
+    rows = {"inb_dropped": np.zeros(3, np.int32),
+            "rc_overflow": np.array([3, 4, 0], np.int32),
+            "delivered": np.array([100, 150, 100], np.int32)}
+    with caplog.at_level(logging.WARNING, logger="gossip_sim_tpu.cli"):
+        dropped, overflow = _warn_shape_truncation(rows, params)
+    assert (dropped, overflow) == (0, 7)
+    msg = "\n".join(r.getMessage() for r in caplog.records)
+    assert "7 received-cache entries" in msg
+    assert "(2.00% of the 350 entries received)" in msg
+
+    # missing/zero delivered denominator: warn without a bogus percentage
+    caplog.clear()
+    rows = {"inb_dropped": np.zeros(1, np.int32),
+            "rc_overflow": np.array([5], np.int32)}
+    with caplog.at_level(logging.WARNING, logger="gossip_sim_tpu.cli"):
+        _warn_shape_truncation(rows, params)
+    msg = "\n".join(r.getMessage() for r in caplog.records)
+    assert "5 received-cache entries" in msg and "%" not in msg

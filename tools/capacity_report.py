@@ -9,7 +9,8 @@ Answers the ROADMAP item 1 planning questions directly:
     (``--fit-budget 16GB``)
   * what would n=100k / n=1M cost, and which dense terms blow up?
     (``--project``; the O(N^2)-flagged arrays under the all-origins
-    interpretation are exactly the tables the sparse refactor removes)
+    interpretation are exactly the tables the sparse representation
+    removes — price it with ``--representation sparse``)
 
 The all-origins interpretation (``--all-origins``, default ON — it is
 the north-star workload) scales the origin axis with N, so every
@@ -17,15 +18,19 @@ the north-star workload) scales the origin axis with N, so every
 analyzes a fixed batch (memory then scales linearly and the fit answers
 "how big a cluster fits per batch").
 
-NOTE the engine's i32 sort-key packing caps num_nodes at 32767
-(engine/core.py MAX_NODES); projections beyond it quantify the payoff of
-lifting that cap, they do not claim today's engine runs there.
+``--representation sparse`` prices the sparse frontier engine
+(engine/sparse.py): the rc_shi/rc_slo stake planes leave the ledger
+(derived per round from the cluster tables), which is what moves the
+16 GB all-origins fit past the dense wall.  The i64 sort-key path lifts
+the old 32767 i32 packing cap to MAX_NODES = 2^24 (engine/core.py), so
+the 100k/1M projections are engine-reachable sizes, not hypotheticals.
 
 Usage:
   python tools/capacity_report.py [--num-nodes 1000] [--fit-budget 16GB]
       [--project 100000,1000000] [--all-origins | --origin-batch B]
-      [--sweep-lanes K] [--traffic-values M] [--gossip-mode MODE]
-      [--trace] [--top 12] [--json]
+      [--representation dense|sparse] [--sweep-lanes K]
+      [--traffic-values M] [--gossip-mode MODE] [--trace] [--top 12]
+      [--json]
 """
 import argparse
 import json
@@ -37,7 +42,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from gossip_sim_tpu.engine.params import EngineParams  # noqa: E402
 from gossip_sim_tpu.obs import capacity  # noqa: E402
 
-ENGINE_NODE_CAP = 32767  # engine/core.py MAX_NODES (i32 sort-key packing)
+ENGINE_NODE_CAP = 1 << 24  # engine/core.py MAX_NODES (i64 sort-key path)
 
 
 def human(n: float) -> str:
@@ -57,7 +62,8 @@ def build_params(args, num_nodes: int) -> EngineParams:
     return EngineParams(num_nodes=num_nodes,
                         push_fanout=args.push_fanout,
                         active_set_size=args.active_set_size,
-                        gossip_mode=args.gossip_mode, **caps)
+                        gossip_mode=args.gossip_mode,
+                        representation=args.representation, **caps)
 
 
 def main() -> int:
@@ -69,6 +75,12 @@ def main() -> int:
     ap.add_argument("--active-set-size", type=int, default=12)
     ap.add_argument("--gossip-mode", default="push",
                     choices=["push", "pull", "push-pull", "adaptive"])
+    ap.add_argument("--representation", default="dense",
+                    choices=["dense", "sparse"],
+                    help="engine execution layout to price: sparse drops "
+                         "the rc_shi/rc_slo [O,N,C] stake planes (derived "
+                         "from the cluster tables each round, "
+                         "engine/sparse.py)")
     ap.add_argument("--traffic-values", type=int, default=1,
                     help="analyze the traffic engine with M value slots")
     ap.add_argument("--node-ingress-cap", type=int, default=0)
@@ -118,7 +130,8 @@ def main() -> int:
                             "bytes_per_node": round(total / n, 2),
                             "beyond_engine_cap": n > ENGINE_NODE_CAP})
 
-    answers = {"ledger": led, "projections": projections}
+    answers = {"ledger": led, "projections": projections,
+               "representation": args.representation}
     if args.fit_budget:
         budget = capacity.parse_size(args.fit_budget)
         fit_n = capacity.fit_budget(params, budget, origin_batch=ob,
@@ -138,7 +151,7 @@ def main() -> int:
     mode = ("all-origins (O tracks N)" if osn
             else f"origin_batch={ob}")
     print(f"capacity ledger: n={args.num_nodes} {mode} "
-          f"mode={args.gossip_mode}"
+          f"mode={args.gossip_mode} repr={args.representation}"
           + (f" M={args.traffic_values}" if args.traffic_values > 1 else "")
           + (f" lanes={args.sweep_lanes}" if args.sweep_lanes else "")
           + (" +trace" if args.trace else ""))
@@ -169,14 +182,15 @@ def main() -> int:
               + (f" (+ {len(ws_dense)} workspace sort-buffer estimates, "
                  f"measured by the XLA temp-bytes harvest)"
                  if ws_dense else ""))
-        print("  (these are the tables ROADMAP item 1's sparse "
-              "O(N*fanout) refactor removes)")
+        if args.representation == "dense":
+            print("  (compare --representation sparse: the rc stake "
+                  "planes leave the ledger, engine/sparse.py)")
 
     if projections:
         print("  projections (closed-form, exact):")
         for pr in projections:
-            cap_note = ("  [beyond engine cap 32767: needs the sparse "
-                        "refactor]" if pr["beyond_engine_cap"] else "")
+            cap_note = ("  [beyond engine cap 2^24: shard nodes]"
+                        if pr["beyond_engine_cap"] else "")
             print(f"    n={pr['num_nodes']:>9,}: "
                   f"{human(pr['total_bytes']):>12} "
                   f"({pr['bytes_per_node']} B/node){cap_note}")
@@ -186,7 +200,7 @@ def main() -> int:
         print(f"  fit --fit-budget {fb['budget']} "
               f"({human(fb['budget_bytes'])}): largest N = "
               f"{fb['largest_n']:,}"
-              + ("  [beyond engine cap 32767]"
+              + ("  [beyond engine cap 2^24]"
                  if fb["beyond_engine_cap"] else ""))
         blocked = [pr for pr in projections
                    if pr["num_nodes"] > fb["largest_n"]]

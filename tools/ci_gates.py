@@ -36,10 +36,21 @@ The gates, in dependency-light-first order:
                 schema-valid JSON, advancing round counters), event-log
                 v1 schema validation with a 1:1 join against the run
                 journal's committed units, zero bit-impact, overhead <2%
+  bench_trend   BENCH_r*.json trend regression (ISSUE 19): the two most
+                recent committed bench snapshots compared metric by
+                metric; any >10% regression on a tracked metric fails
+                CI instead of relying on manual diffing
+  sparse_smoke  sparse frontier engine (ISSUE 19): dense/sparse CLI-run
+                bit parity at 1k under loss+churn, 1k-node sparse
+                engine-vs-CPU-oracle parity, representation=dense
+                bit-equal to the committed pre-PR golden, sparse
+                capacity-ledger closed forms == live nbytes at two
+                (N, C) points, 16GB all-origins fit strictly beyond
+                the dense ceiling
 
 Usage: python tools/ci_gates.py [--only NAME[,NAME...]] [--list] [--json]
 
-``--only`` runs a subset (twelve serial gates take a while — pick the
+``--only`` runs a subset (fourteen serial gates take a while — pick the
 ones your change touches); ``--list`` prints the registry and exits.
 The summary table carries each gate's wall time; ``--json`` replaces it
 with one machine-readable JSON object (the last line of output) carrying
@@ -58,7 +69,12 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
          "pull_smoke", "lane_smoke", "resume_smoke", "traffic_smoke",
          "adaptive_smoke", "capacity_smoke", "health_smoke",
-         "telemetry_smoke"]
+         "telemetry_smoke", "bench_trend", "sparse_smoke"]
+
+# per-gate extra argv: most gates run bare; bench_trend only gates CI
+# when asked to fail on regressions, and only on the newest committed
+# round (the history carries known, documented re-budgeting slowdowns)
+GATE_ARGS = {"bench_trend": ["--fail-on-regression", "--latest-only"]}
 
 
 def main() -> int:
@@ -93,7 +109,8 @@ def main() -> int:
         t0 = time.time()
         try:
             rc = subprocess.run(
-                [sys.executable, os.path.join(HERE, f"{gate}.py")],
+                [sys.executable, os.path.join(HERE, f"{gate}.py")]
+                + GATE_ARGS.get(gate, []),
                 env=env, timeout=args.timeout).returncode
         except subprocess.TimeoutExpired:
             rc = -9

@@ -1,0 +1,277 @@
+"""Sparse frontier engine smoke test: the CI gate for engine/sparse.py +
+the ``EngineStatic.representation`` compile key (ISSUE 19).
+
+Fast CPU gate (~2-3 min) over six contracts:
+
+  1. **Dense/sparse bit parity at 1k under faults**: the full CLI run
+     (stats parity snapshot + deterministic Influx wire lines) is
+     bit-identical between ``--engine-representation dense`` and
+     ``sparse`` at 1000 nodes under packet loss + churn.
+  2. **1k-node CPU-oracle parity**: the sparse engine bit-matches the
+     loop-based oracle Cluster (forced-identical active sets, rotation
+     off, FaultInjector-driven loss + churn) on distances, RMR m/n,
+     delivered/dropped counters and the failed mask, every round.
+  3. **Dense unchanged**: ``representation="dense"`` reproduces the
+     committed pre-PR golden (tests/fixtures/sparse/dense_golden.json —
+     parity snapshot + wire lines captured from the tree before the
+     sparse engine landed) bit-for-bit.
+  4. **Ledger exactness**: the capacity ledger's sparse-group closed
+     forms equal the live donated buffers' ``nbytes`` per field and in
+     total at two (N, C) points, and the rc stake planes really carry
+     zero bytes under sparse.
+  5. **The wall moves**: ``fit_budget(16GB)`` under the all-origins
+     interpretation reports a strictly larger max-N for sparse than for
+     dense, and clears the dense engine's documented 3,914 ceiling.
+  6. **i64 key-width parity**: ``FORCE_I64_KEYS`` drives a
+     within-i32-bound cluster through the i64 sort-key arms
+     (engine/core.py) and every engine row stays bit-identical — run
+     here rather than tier-1 because the required compile-cache clears
+     would force the whole test suite behind it to recompile.
+
+Usage: python tools/sparse_smoke.py [--seed 7] [--num-nodes 1000]
+       [--rounds 6]
+
+Exit code 0 = all contracts hold; 1 = a sparse invariant failed.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GOLDEN = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "fixtures", "sparse",
+    "dense_golden.json")
+DENSE_CEILING = 3914  # the pre-sparse 16GB all-origins fit (PR 13)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="sparse frontier engine smoke (CPU, <3min)")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--num-nodes", type=int, default=1000)
+    ap.add_argument("--rounds", type=int, default=6)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gossip_sim_tpu.cli import run_simulation
+    from gossip_sim_tpu.config import Config
+    from gossip_sim_tpu.constants import UNREACHED
+    from gossip_sim_tpu.engine import (EngineParams, init_state,
+                                       make_cluster_tables, run_rounds)
+    from gossip_sim_tpu.faults import FaultInjector
+    from gossip_sim_tpu.identity import (NodeIndex, get_stake_bucket,
+                                         pubkey_new_unique,
+                                         reset_unique_pubkeys)
+    from gossip_sim_tpu.obs import capacity
+    from gossip_sim_tpu.obs.spans import get_registry
+    from gossip_sim_tpu.oracle.cluster import Cluster, Node
+    from gossip_sim_tpu.resilience import snapshot_to_jsonable
+    from gossip_sim_tpu.sinks import DatapointQueue
+    from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+
+    t_start = time.time()
+    failures = []
+
+    def check(ok: bool, msg: str):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}")
+        if not ok:
+            failures.append(msg)
+
+    # ---- gate 1: dense/sparse full-run bit parity at 1k -----------------
+    print("[1/6] dense vs sparse CLI-run bit parity at "
+          f"{args.num_nodes} nodes under loss+churn")
+
+    def run_single(representation: str, n: int, iters: int = 8,
+                   warm: int = 2):
+        reset_unique_pubkeys()
+        get_registry().reset()
+        cfg = Config(num_synthetic_nodes=n, gossip_iterations=iters,
+                     warm_up_rounds=warm, seed=args.seed,
+                     packet_loss_rate=0.05, churn_fail_rate=0.02,
+                     churn_recover_rate=0.2,
+                     engine_representation=representation)
+        coll = GossipStatsCollection()
+        coll.set_number_of_simulations(1)
+        dpq = DatapointQueue()
+        run_simulation(cfg, "", coll, dpq, 0, "0", 0.0)
+        return (coll.collection[0].parity_snapshot(),
+                dpq.drain_deterministic_lines())
+
+    snap_d, wire_d = run_single("dense", args.num_nodes)
+    snap_s, wire_s = run_single("sparse", args.num_nodes)
+    check(snap_d == snap_s,
+          "sparse moves zero bits of the stats parity snapshot")
+    check(wire_d == wire_s,
+          "sparse moves zero bits of the deterministic Influx wire lines")
+
+    # ---- gate 2: 1k-node sparse-engine-vs-oracle parity -----------------
+    print(f"[2/6] sparse engine vs CPU oracle at {args.num_nodes} nodes "
+          "(forced active sets, rotation off, loss+churn)")
+    n = args.num_nodes
+    knobs = dict(packet_loss_rate=0.1, churn_fail_rate=0.02,
+                 churn_recover_rate=0.25)
+    reset_unique_pubkeys()
+    rng = np.random.default_rng(17)
+    stakes_arr = rng.choice(np.arange(1, 50 * n), size=n,
+                            replace=False).astype(np.int64) * 10**9
+    accounts = {pubkey_new_unique(): int(s) for s in stakes_arr}
+    index = NodeIndex.from_stakes(accounts)
+    stakes_np = index.stakes.astype(np.int64)
+    tables = make_cluster_tables(stakes_np)
+    params = EngineParams(num_nodes=n, probability_of_rotation=0.0,
+                          warm_up_rounds=0, impair_seed=args.seed,
+                          representation="sparse", **knobs).validate()
+    origins = jnp.asarray([0], jnp.int32)
+    state = init_state(jax.random.PRNGKey(11), tables, origins, params)
+
+    stakes_map = {pk: int(s) for pk, s in zip(index.pubkeys, stakes_np)}
+    nodes = [Node(pk, stakes_map[pk]) for pk in index.pubkeys]
+    origin_pk = index.pubkeys[0]
+    active = np.asarray(state.active[0])
+    for i, node in enumerate(nodes):
+        bucket = get_stake_bucket(min(stakes_map[node.pubkey],
+                                      stakes_map[origin_pk]))
+        entry = node.active_set.entries[bucket]
+        entry.peers = {index.pubkeys[j]: {index.pubkeys[j]}
+                       for j in active[i] if j < n}
+    node_map = {nd.pubkey: nd for nd in nodes}
+    cluster = Cluster(params.push_fanout)
+    impair = FaultInjector(index, seed=args.seed, **knobs)
+
+    state, rows = run_rounds(params, tables, origins, state,
+                             args.rounds, detail=True)
+    dist_e = np.asarray(rows["dist"])[:, 0]
+    failed_e = np.asarray(rows["failed_mask"])[:, 0]
+    m_e = np.asarray(rows["m"])[:, 0]
+    n_e = np.asarray(rows["n"])[:, 0]
+    delivered_e = np.asarray(rows["delivered"])[:, 0]
+    dropped_e = np.asarray(rows["dropped"])[:, 0]
+
+    dist_ok = fail_ok = rmr_ok = impair_ok = True
+    saw_drop = saw_churn = False
+    for r in range(args.rounds):
+        impair.begin_round(r)
+        newly_failed, newly_recovered = impair.churn_step(
+            r, node_map, cluster.failed_nodes)
+        saw_churn |= bool(newly_failed or newly_recovered)
+        cluster.run_gossip(origin_pk, stakes_map, node_map, impair)
+        cluster.consume_messages(origin_pk, nodes)
+        cluster.send_prunes(origin_pk, nodes,
+                            params.prune_stake_threshold,
+                            params.min_ingress_nodes, stakes_map)
+        failed_o = np.array([node_map[pk].failed for pk in index.pubkeys])
+        fail_ok &= bool(np.array_equal(failed_e[r], failed_o))
+        dist_o = np.array(
+            [-1 if cluster.distances[pk] == UNREACHED
+             else cluster.distances[pk] for pk in index.pubkeys])
+        dist_ok &= bool(np.array_equal(dist_e[r], dist_o))
+        rmr_ok &= (m_e[r] == cluster.rmr.m and n_e[r] == cluster.rmr.n)
+        impair_ok &= (delivered_e[r] == impair.delivered
+                      and dropped_e[r] == impair.dropped)
+        saw_drop |= impair.dropped > 0
+        cluster.prune_connections(node_map, stakes_map)
+
+    check(dist_ok, f"delivery distances bit-equal for {args.rounds} rounds")
+    check(fail_ok, "churned failed mask bit-equal every round")
+    check(rmr_ok, "RMR m/n counters bit-equal every round")
+    check(impair_ok, "delivered/dropped counters bit-equal every round")
+    check(saw_drop and saw_churn,
+          "the regime exercised packet loss AND churn")
+    check(tuple(np.asarray(state.rc_shi).shape) == (1, n, 0),
+          "sparse state carries the rc stake planes at zero width")
+
+    # ---- gate 3: dense unchanged vs the pre-PR golden -------------------
+    print("[3/6] representation=dense reproduces the pre-PR golden")
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    snap_g, wire_g = run_single("dense", 300, iters=10, warm=2)
+    check(snapshot_to_jsonable(snap_g) == golden["snapshot"],
+          "dense parity snapshot bit-equal to the pre-PR fixture")
+    check(wire_g == golden["lines"],
+          "dense Influx wire lines bit-equal to the pre-PR fixture")
+
+    # ---- gate 4: ledger exactness at two (N, C) points ------------------
+    print("[4/6] sparse capacity-ledger closed forms vs live nbytes")
+    for (nn, cc) in ((500, 64), (1000, 50)):
+        p = EngineParams(num_nodes=nn, rc_slots=cc, warm_up_rounds=0,
+                         representation="sparse")
+        rng = np.random.default_rng(0)
+        sk = rng.choice(np.arange(1, 10 * nn), size=nn,
+                        replace=False).astype(np.int64)
+        tb = make_cluster_tables(sk)
+        org = jnp.arange(3, dtype=jnp.int32)
+        st = init_state(jax.random.PRNGKey(0), tb, org, p)
+        entries = capacity.sim_state_entries(p, origin_batch=3)
+        live = {f: getattr(st, f).nbytes for f in st._fields}
+        exact = all(e.bytes == live[e.name] for e in entries)
+        total_ok = sum(e.bytes for e in entries) == sum(live.values())
+        check(exact and total_ok,
+              f"(N={nn}, C={cc}): every ledger field == live nbytes, "
+              f"totals equal")
+        check(any(e.group == "sparse" for e in entries),
+              f"(N={nn}, C={cc}): the 'sparse' ledger group is present")
+        check(live["rc_shi"] == 0 and live["rc_slo"] == 0,
+              f"(N={nn}, C={cc}): rc stake planes carry zero live bytes")
+
+    # ---- gate 5: the 16GB all-origins wall moves ------------------------
+    print("[5/6] fit_budget(16GB, all-origins): sparse beats dense")
+    pd = EngineParams(num_nodes=1000, warm_up_rounds=0)
+    ps = pd._replace(representation="sparse")
+    budget = 16 << 30
+    fit_d = capacity.fit_budget(pd, budget, origin_batch=1,
+                                origins_scale_with_n=True)
+    fit_s = capacity.fit_budget(ps, budget, origin_batch=1,
+                                origins_scale_with_n=True)
+    print(f"  dense fit: N={fit_d:,}  sparse fit: N={fit_s:,}")
+    check(fit_s > fit_d, "sparse max-N strictly greater than dense")
+    check(fit_s > DENSE_CEILING,
+          f"sparse max-N clears the documented dense ceiling "
+          f"({DENSE_CEILING:,})")
+
+    # ---- gate 6: FORCE_I64_KEYS bit parity on an i32-bound cluster ------
+    print("[6/6] i64 sort-key arms bit-equal to i32 (FORCE_I64_KEYS)")
+    from gossip_sim_tpu.engine import clear_compile_cache
+    from gossip_sim_tpu.engine import core as engine_core
+
+    def run_small():
+        sk = np.random.default_rng(5).choice(
+            np.arange(1, 10_000), size=200, replace=False).astype(
+            np.int64) * 10**9
+        tb = make_cluster_tables(sk)
+        pp = EngineParams(num_nodes=200, warm_up_rounds=0)
+        org = jnp.arange(2, dtype=jnp.int32)
+        st = init_state(jax.random.PRNGKey(7), tb, org, pp)
+        _, rows = run_rounds(pp, tb, org, st, 6)
+        return rows
+
+    ref_rows = run_small()
+    try:
+        engine_core.FORCE_I64_KEYS = True
+        clear_compile_cache()
+        wide_rows = run_small()
+    finally:
+        engine_core.FORCE_I64_KEYS = False
+        clear_compile_cache()
+    i64_ok = all(np.array_equal(np.asarray(ref_rows[k]),
+                                np.asarray(wide_rows[k])) for k in ref_rows)
+    check(i64_ok, "every engine row bit-equal across the key widths")
+
+    print(f"  elapsed: {time.time() - t_start:.1f}s")
+    if failures:
+        print(f"SPARSE SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("SPARSE SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
