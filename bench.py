@@ -382,6 +382,85 @@ def worker(args) -> int:
     sparse_capacity = rung_capacity(sparams, "engine/run_rounds",
                                     origin_batch=so)
 
+    # ---- serve rung: continuous-batching request throughput ------------
+    # (serve/, ISSUE 20).  K dynamically-membered lanes stream a queue of
+    # scenario requests through the ONE warm dyn-lane executable the
+    # --serve daemon holds: each request runs sweep_iters rounds in
+    # blocks, and a lane splices the next queued request the block after
+    # its current one finishes — exactly the daemon's block-boundary
+    # admission.  requests/sec here is the device-plane serve throughput
+    # (host-side stats harvest + HTTP ride on top in the live daemon;
+    # tools/serve_smoke.py gates that plane's correctness bit-for-bit).
+    from gossip_sim_tpu.engine import (dyn_lane_cache_size,
+                                       run_rounds_lanes_dyn,
+                                       splice_lane_state, stack_origins)
+    vn = tn                       # n<=1000, same cluster as traffic rung
+    vparams = EngineParams(num_nodes=vn, warm_up_rounds=0).validate()
+    vtables = ttables_c if tn == vn else make_cluster_tables(tstakes)
+    vstatic = vparams.static_part()
+    klanes = 4
+    vreqs = 3 * klanes
+    vblock = next(b for b in range(min(5, sweep_iters), 0, -1)
+                  if sweep_iters % b == 0)
+
+    def _req_init(i):
+        # per-request identity: own seed, origin, and a traced knob value
+        knobs = vparams._replace(
+            probability_of_rotation=0.013333 + 1e-4 * (i + 1))
+        org = jnp.asarray([i % vn], jnp.int32)
+        st = init_state(jax.random.PRNGKey(i), vtables, org, knobs)
+        return knobs.knob_values(), org, st
+
+    def _serve_stream():
+        lane_req = list(range(klanes))       # request index per lane
+        lane_done = [0] * klanes             # rounds done per lane
+        inits = [_req_init(i) for i in range(klanes)]
+        lane_kvals = [kv for kv, _, _ in inits]   # per-lane knob tuples
+        lane_orgs = [org for _, org, _ in inits]  # per-lane origin rows
+        kstack = stack_knobs(lane_kvals)
+        ostack = stack_origins(lane_orgs)
+        states = broadcast_state(inits[0][2], klanes)
+        for k in range(1, klanes):
+            states = splice_lane_state(states, k, inits[k][2])
+        next_req, completed = klanes, 0
+        while completed < vreqs:
+            states, vrows = run_rounds_lanes_dyn(
+                vstatic, vtables, ostack, states, kstack, vblock,
+                start_its=jnp.asarray(lane_done, jnp.int32))
+            jax.block_until_ready(vrows["coverage"])
+            for k in range(klanes):
+                if lane_req[k] < 0:
+                    continue
+                lane_done[k] += vblock
+                if lane_done[k] < sweep_iters:
+                    continue
+                completed += 1
+                if next_req < vreqs:         # splice the next request in
+                    kv, org, st = _req_init(next_req)
+                    lane_req[k], next_req = next_req, next_req + 1
+                    lane_done[k] = 0
+                    lane_kvals[k], lane_orgs[k] = kv, org
+                    kstack = stack_knobs(lane_kvals)
+                    ostack = stack_origins(lane_orgs)
+                    states = splice_lane_state(states, k, st)
+                else:                        # idle lane keeps stepping
+                    lane_req[k] = -1
+        return completed
+
+    h0 = harvest_s()
+    t_vc = time.perf_counter()
+    _serve_stream()                          # cold: dyn kernel compiles
+    serve_compile_dt = time.perf_counter() - t_vc - (harvest_s() - h0)
+    c_warm = dyn_lane_cache_size()
+    h0 = harvest_s()
+    t_vr = time.perf_counter()
+    serve_completed = _serve_stream()        # warm: the daemon's regime
+    serve_dt = time.perf_counter() - t_vr - (harvest_s() - h0)
+    serve_compiles = (dyn_lane_cache_size() - c_warm
+                      if c_warm >= 0 else -1)
+    serve_capacity = rung_capacity(vparams, "engine/run_rounds_lanes_dyn",
+                                   lanes=klanes)
+
     result = bench_summary(
         reg, platform=platform, num_nodes=n, origin_batch=o,
         iterations=args.iterations,
@@ -480,6 +559,19 @@ def worker(args) -> int:
         "first_call_elapsed_s": round(sparse_compile_dt, 3),
         "coverage_mean": round(sparse_cov, 4),
         **sparse_capacity,
+    }
+    result["serve_requests_per_sec"] = round(
+        serve_completed / serve_dt, 3) if serve_dt > 0 else 0.0
+    result["serve"] = {
+        "num_nodes": vn,
+        "lanes": klanes,
+        "requests": vreqs,
+        "rounds_per_request": sweep_iters,
+        "block_rounds": vblock,
+        "warm_elapsed_s": round(serve_dt, 3),
+        "first_call_elapsed_s": round(serve_compile_dt, 3),
+        "compiles_during_stream": serve_compiles,
+        **serve_capacity,
     }
     # run-level capacity line (ROADMAP item 1's measured memory baseline;
     # tools/bench_trend.py tracks these across rounds)

@@ -595,6 +595,47 @@ def build_parser() -> argparse.ArgumentParser:
                         "the resilience journal's committed units; append "
                         "mode makes one PATH span an interrupted-and-"
                         "resumed run")
+    p.add_argument("--serve", action="store_true",
+                   help="gossip-as-a-service (serve/, ISSUE 20): run a "
+                        "long-lived continuous-batching daemon holding "
+                        "--serve-lanes warm device lanes, admitting "
+                        "scenario requests over POST /submit on the "
+                        "telemetry port or a watched --serve-spool-dir. "
+                        "Also reachable as `python -m gossip_sim_tpu "
+                        "serve`")
+    p.add_argument("--serve-lanes", type=int, default=4, metavar="K",
+                   help="warm device lanes the serve daemon batches "
+                        "(fixed compile geometry; requests splice into "
+                        "free lanes as others retire)")
+    p.add_argument("--serve-block-rounds", type=int, default=25,
+                   metavar="B",
+                   help="serve scheduler tick: rounds per batched "
+                        "dispatch, snapped down to a divisor of "
+                        "--iterations so lanes retire exactly at block "
+                        "boundaries")
+    p.add_argument("--serve-memory-budget", default="", metavar="BYTES",
+                   help="ledger budget gating serve admission (e.g. "
+                        "2GiB): requests are priced with the closed-form "
+                        "capacity ledger BEFORE any device contact; "
+                        "over-budget submissions get 413 with the "
+                        "predicted and available byte counts (empty = "
+                        "unmetered)")
+    p.add_argument("--serve-max-queue", type=int, default=64,
+                   help="queued serve requests across all tenants before "
+                        "submissions get 429 (FIFO per tenant, "
+                        "round-robin across tenants)")
+    p.add_argument("--serve-spool-dir", default="", metavar="DIR",
+                   help="watched serve intake directory: drop "
+                        "<name>.json request specs, collect "
+                        "<id>.result.json")
+    p.add_argument("--serve-max-requests", type=int, default=0,
+                   metavar="N",
+                   help="exit 0 after N completed serve requests "
+                        "(0 = run until SIGTERM; smoke/bench hook)")
+    p.add_argument("--serve-idle-timeout-s", type=float, default=0.0,
+                   help="exit 0 after this many seconds with no running "
+                        "or queued serve request (0 = run until "
+                        "SIGTERM)")
     return p
 
 
@@ -700,6 +741,14 @@ def config_from_args(args) -> Config:
         compilation_cache_dir=args.compilation_cache_dir,
         telemetry_port=args.telemetry_port,
         event_log=args.event_log,
+        serve=args.serve,
+        serve_lanes=args.serve_lanes,
+        serve_block_rounds=args.serve_block_rounds,
+        serve_memory_budget=args.serve_memory_budget,
+        serve_max_queue=args.serve_max_queue,
+        serve_spool_dir=args.serve_spool_dir,
+        serve_max_requests=args.serve_max_requests,
+        serve_idle_timeout_s=args.serve_idle_timeout_s,
     )
 
 
@@ -3578,6 +3627,10 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="[%(asctime)s %(levelname)s %(name)s] %(message)s")
+    argv = list(argv) if argv is not None else sys.argv[1:]
+    if argv[:1] == ["serve"]:
+        # subcommand alias: `python -m gossip_sim_tpu serve ...`
+        argv = ["--serve"] + argv[1:]
     args = build_parser().parse_args(argv)
     config = config_from_args(args)
     # one process == one run: start the telemetry registry clean so spans,
@@ -3745,6 +3798,45 @@ def main(argv=None) -> int:
                     "No stats will be recorded....",
                     config.gossip_iterations, config.warm_up_rounds)
 
+    if config.serve:
+        # gossip-as-a-service daemon (serve/, ISSUE 20): validate the
+        # service geometry up front — requests can only vary traced
+        # knobs, so the base config must pin a servable shape
+        if config.backend != "tpu":
+            log.error("ERROR: --serve requires --backend tpu")
+            return 1
+        if config.traffic_on or config.all_origins:
+            log.error("ERROR: --serve is a single-origin scenario "
+                      "service; concurrent traffic and --all-origins "
+                      "are separate workload modes")
+            return 1
+        if config.test_type != Testing.NO_TEST:
+            log.error("ERROR: --serve runs NO_TEST scenarios (each "
+                      "request carries its own knobs); drop --test-type")
+            return 1
+        if config.gossip_iterations <= config.warm_up_rounds:
+            log.error("ERROR: --serve needs --iterations > "
+                      "--warm-up-rounds (a request would have nothing "
+                      "measurable)")
+            return 1
+        if config.serve_lanes < 1:
+            log.error("ERROR: --serve-lanes must be >= 1")
+            return 1
+        if config.serve_block_rounds < 1:
+            log.error("ERROR: --serve-block-rounds must be >= 1")
+            return 1
+        if config.trace_dir:
+            log.error("ERROR: --trace-dir is not supported with --serve "
+                      "(a lane batch interleaves K requests' event "
+                      "streams in one capture buffer)")
+            return 1
+        if config.telemetry_port < 0:
+            # the daemon's intake rides the telemetry plane; bind an
+            # ephemeral port when none was requested (the bound port is
+            # logged, stamped into registry info, and discoverable from
+            # the event log's telemetry_listen record)
+            config = config.stepped(telemetry_port=0)
+
     start_ts = str(time.time_ns())
     log.info("############################################")
     log.info("##### START_TIME: %s ######", start_ts)
@@ -3809,9 +3901,19 @@ def main(argv=None) -> int:
 
     collection = None
     traffic_summary = None
+    serve_summary = None
     try:
         with signal_guard():
-            if config.traffic_on:
+            if config.serve:
+                # gossip-as-a-service: the daemon runs on this (main)
+                # thread until --serve-max-requests/--serve-idle-timeout-s
+                # or a drain-and-exit (ResumableInterrupt -> the 75 path
+                # below, with every completion already journaled)
+                from .serve import run_serve
+                serve_summary = run_serve(config, args.json_rpc_url,
+                                          dp_queue, start_ts,
+                                          telemetry_server)
+            elif config.traffic_on:
                 traffic_summary = run_traffic(config, args.json_rpc_url,
                                               dp_queue, start_ts)
             elif config.all_origins:
@@ -3848,6 +3950,13 @@ def main(argv=None) -> int:
                     f"; resume with --resume {ckpt}" if ckpt else
                     " (no --checkpoint-path: a re-run starts from scratch)")
         return _finish_telemetry(RESUMABLE_EXIT_CODE)
+
+    if config.serve:
+        influx_stats = _drain_influx(dp_queue, influx_thread,
+                                     start_ts, emit_capacity=True)
+        _write_run_report(config, stats=serve_summary,
+                          influx=influx_stats)
+        return _finish_telemetry(0)
 
     if config.traffic_on:
         influx_stats = _drain_influx(dp_queue, influx_thread,

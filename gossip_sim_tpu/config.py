@@ -243,6 +243,25 @@ class Config:
                                     # append heartbeat/journal/watchdog/
                                     # Influx/signal events as JSONL here
 
+    # -- gossip-as-a-service daemon (serve/, ISSUE 20) ---------------------
+    serve: bool = False             # run the continuous-batching scenario
+                                    # daemon instead of a one-shot path
+    serve_lanes: int = 4            # K: warm device lanes the daemon holds
+    serve_block_rounds: int = 25    # scheduler tick granularity (rounds per
+                                    # dispatch; snapped down to a divisor of
+                                    # gossip_iterations so lanes retire
+                                    # exactly at block boundaries)
+    serve_memory_budget: str = ""   # ledger budget gating admission
+                                    # (parse_size: "16GB"; "" = unlimited)
+    serve_max_queue: int = 64       # queued requests across all tenants
+                                    # before 429 (0 = reject when no lane)
+    serve_spool_dir: str = ""       # watched intake directory (*.json
+                                    # request specs; results written back)
+    serve_max_requests: int = 0     # exit 0 after N completions (0 = run
+                                    # until idle-timeout/signal; gates+bench)
+    serve_idle_timeout_s: float = 0.0  # exit 0 after this long with no
+                                    # work in flight or queued (0 = never)
+
     def stepped(self, **kw) -> "Config":
         return replace(self, **kw)
 

@@ -42,12 +42,17 @@ from .core import (  # noqa: E402
 from .lanes import (  # noqa: E402
     broadcast_state,
     check_lane_knobs,
+    clear_dyn_lane_cache,
     clear_lane_cache,
+    dyn_lane_cache_size,
     lane_cache_size,
     lane_state,
     num_lanes,
     run_rounds_lanes,
+    run_rounds_lanes_dyn,
+    splice_lane_state,
     stack_knobs,
+    stack_origins,
 )
 
 __all__ = [
@@ -62,7 +67,12 @@ __all__ = [
     "lane_state",
     "num_lanes",
     "run_rounds_lanes",
+    "run_rounds_lanes_dyn",
+    "splice_lane_state",
     "stack_knobs",
+    "stack_origins",
+    "clear_dyn_lane_cache",
+    "dyn_lane_cache_size",
     "SamplerTables",
     "build_sampler_tables",
     "ClusterTables",

@@ -95,6 +95,35 @@ def lane_state(states: SimState, lane: int) -> SimState:
     return SimState(*(x[lane] for x in states))
 
 
+def stack_origins(origin_list) -> jnp.ndarray:
+    """K per-lane origin index sequences -> one ``[K, O]`` i32 array.
+
+    The dynamic-membership runner (:func:`run_rounds_lanes_dyn`) vmaps
+    the origin axis per lane, so co-resident scenario requests may seed
+    different origins; every lane must carry the same origin *count* O
+    (the compile geometry)."""
+    origin_list = [np.asarray(o, np.int32).reshape(-1) for o in origin_list]
+    if not origin_list:
+        raise ValueError("stack_origins needs at least one lane")
+    widths = {o.shape[0] for o in origin_list}
+    if len(widths) != 1:
+        raise ValueError(f"all lanes must carry the same origin count "
+                         f"(got widths {sorted(widths)})")
+    return jnp.asarray(np.stack(origin_list))
+
+
+def splice_lane_state(states: SimState, lane: int, state: SimState) -> SimState:
+    """Admit one ``[O, ...]`` SimState into lane ``lane`` of a
+    ``[K, O, ...]`` batch, leaving every other lane's buffers untouched.
+
+    This is the admission half of dynamic lane membership: a retired
+    lane's slot is overwritten with a fresh request's state while the
+    surviving lanes keep their exact bits (tests/test_serve.py proves
+    the no-op property for survivors)."""
+    lane = int(lane)
+    return SimState(*(b.at[lane].set(x) for b, x in zip(states, state)))
+
+
 def check_lane_knobs(static: EngineStatic, knob_list) -> None:
     """Per-lane gate guard: every lane's knob vector must be servable by
     the (unioned) static compile key — an active knob against a False
@@ -132,6 +161,68 @@ def clear_lane_cache() -> None:
         _run_lanes.clear_cache()
     except Exception:  # pragma: no cover
         pass
+
+
+@partial(jax.jit, static_argnums=(0, 5, 6), donate_argnums=(3,))
+def _run_lanes_dyn(static, tables, origins, states, knobs, num_iters,
+                   detail, start_its):
+    # Dynamic-membership variant: ``origins`` is [K, O] (each lane seeds
+    # its own origin set) and ``start_its`` is [K] (each lane is at its
+    # own round offset).  ``r + s0`` reproduces _run_lanes's
+    # ``arange + start_it`` i64 arithmetic per lane, so a lane admitted
+    # at wall-block b with offset s0 hashes the exact same per-round
+    # impairment counters a solo run of that scenario would.
+    def step(st, r):
+        def one(s, k, o, s0):
+            return round_step(static, tables, o, s, r + s0, detail=detail,
+                              knobs=k)
+        return jax.vmap(one)(st, knobs, origins, start_its)
+    return lax.scan(step, states, jnp.arange(num_iters))
+
+
+def dyn_lane_cache_size() -> int:
+    """Executables in the dynamic-membership runner's jit cache (-1 when
+    the running JAX exposes no introspection)."""
+    try:
+        return int(_run_lanes_dyn._cache_size())
+    except Exception:  # pragma: no cover - older/newer jax internals
+        return -1
+
+
+def clear_dyn_lane_cache() -> None:
+    """Drop every compiled dynamic-lane executable."""
+    try:
+        _run_lanes_dyn.clear_cache()
+    except Exception:  # pragma: no cover
+        pass
+
+
+def run_rounds_lanes_dyn(static: EngineStatic, tables, origins,
+                         states: SimState, knobs: EngineKnobs,
+                         num_iters: int, start_its, detail: bool = False):
+    """One block of K dynamically-membered lanes as one jitted scan.
+
+    The serve daemon's execution primitive (ISSUE 20): ``origins`` is a
+    ``[K, O]`` i32 array (:func:`stack_origins`) and ``start_its`` a
+    ``[K]`` i32 vector — each lane runs rounds ``start_its[k] ..
+    start_its[k] + num_iters`` of its own scenario, so freshly admitted
+    requests (offset 0) ride the same dispatch as lanes deep into their
+    run.  Idle lanes simply keep stepping their last state; their rows
+    are discarded host-side (masking is scheduling, not arithmetic), so
+    an evicted lane is a bit-exact no-op for survivors.  Shapes are
+    fixed by (K, O, num_iters): steady-state admissions re-enter one
+    warm executable with zero recompiles.  Compile accounting lands on
+    the same ``engine/compiles`` / ``engine/cache_hits`` counters as
+    every other runner."""
+    args = (static, tables, jnp.asarray(origins, jnp.int32), states, knobs,
+            int(num_iters), bool(detail),
+            jnp.asarray(start_its, jnp.int32))
+    capacity.harvest_dispatch("engine/run_rounds_lanes_dyn", _run_lanes_dyn,
+                              args)
+    before = dyn_lane_cache_size()
+    out = _run_lanes_dyn(*args)
+    _note_compile_accounting(before, dyn_lane_cache_size())
+    return out
 
 
 def run_rounds_lanes(static: EngineStatic, tables, origins, states: SimState,

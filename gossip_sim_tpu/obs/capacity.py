@@ -416,6 +416,22 @@ def predict_sim_state_bytes(params, origin_batch: int = 1,
     return sum(ent.bytes for ent in entries)
 
 
+def predict_request_bytes(params, origins) -> int:
+    """Price one serve/plan request before it touches the device.
+
+    ``origins`` is the request's origin spec — either the origin index
+    sequence itself or an int origin count; the request's device cost is
+    the one ``[O, ...]`` SimState lane it will occupy.  JAX-free closed
+    form shared by the serve admission controller (serve/admission.py)
+    and tools/capacity_report.py, exact against live ``nbytes`` by the
+    same contract as :func:`predict_sim_state_bytes`
+    (tests/test_capacity.py)."""
+    o = int(origins) if isinstance(origins, (int, float)) else len(origins)
+    if o < 1:
+        raise ValueError(f"request needs at least one origin (got {o})")
+    return predict_sim_state_bytes(params, origin_batch=o)
+
+
 def predict_traffic_state_bytes(params, lanes: int = 0) -> int:
     """Exact total bytes of a live :class:`TrafficState`."""
     entries = traffic_state_entries(params)

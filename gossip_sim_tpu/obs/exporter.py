@@ -177,6 +177,37 @@ def prometheus_text(snap: dict) -> str:
            "Structured events emitted this run.",
            [(None, ev.get("emitted", 0))])
 
+    serve = snap.get("serve", {})
+    if serve:
+        metric("serve_lanes", "gauge", "Configured serve lane count.",
+               [(None, serve.get("lanes", 0))])
+        metric("serve_lanes_busy", "gauge",
+               "Lanes currently executing a request.",
+               [(None, serve.get("busy", 0))])
+        metric("serve_queue_depth", "gauge",
+               "Requests admitted but waiting for a lane.",
+               [(None, serve.get("queued", 0))])
+        metric("serve_requests_received_total", "counter",
+               "Scenario requests received.",
+               [(None, serve.get("received", 0))])
+        metric("serve_requests_admitted_total", "counter",
+               "Scenario requests admitted into a lane.",
+               [(None, serve.get("admitted", 0))])
+        metric("serve_requests_rejected_total", "counter",
+               "Scenario requests rejected by admission control.",
+               [(None, serve.get("rejected", 0))])
+        metric("serve_requests_completed_total", "counter",
+               "Scenario requests completed.",
+               [(None, serve.get("completed", 0))])
+        metric("serve_tenant_admitted_total", "counter",
+               "Requests admitted per tenant.",
+               [({"tenant": t}, v) for t, v in
+                sorted(serve.get("tenants_admitted", {}).items())])
+        metric("serve_tenant_rejected_total", "counter",
+               "Requests rejected per tenant.",
+               [({"tenant": t}, v) for t, v in
+                sorted(serve.get("tenants_rejected", {}).items())])
+
     return "\n".join(lines) + "\n"
 
 
@@ -211,9 +242,43 @@ def parse_prometheus_text(text: str) -> dict:
 class _Handler(BaseHTTPRequestHandler):
     server_version = "gossip-sim-telemetry/1"
 
+    def _dispatch_custom(self, routes, url, body=None) -> bool:
+        """Try the server's pluggable routes (the serve daemon mounts
+        /submit, /result/<id>, /serve here).  Exact path match first,
+        then prefix routes (keys ending "/") with the tail passed
+        through.  Handlers return ``(code, payload)``; a dict/list
+        payload goes out as JSON, bytes/str verbatim."""
+        fn = routes.get(url.path)
+        arg = None
+        if fn is None:
+            for key, cand in routes.items():
+                if key.endswith("/") and url.path.startswith(key):
+                    fn, arg = cand, url.path[len(key):]
+                    break
+        if fn is None:
+            return False
+        kwargs = {"query": parse_qs(url.query)}
+        if arg is not None:
+            kwargs["tail"] = arg
+        if body is not None:
+            kwargs["body"] = body
+        code, payload = fn(**kwargs)
+        if isinstance(payload, (dict, list)):
+            self._reply(int(code), "application/json",
+                        (json.dumps(payload, default=str) + "\n")
+                        .encode("utf-8"))
+        else:
+            if isinstance(payload, str):
+                payload = payload.encode("utf-8")
+            self._reply(int(code), "text/plain", payload)
+        return True
+
     def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
         try:
             url = urlparse(self.path)
+            if self._dispatch_custom(
+                    getattr(self.server, "get_routes", {}), url):
+                return
             if url.path == "/metrics":
                 body = prometheus_text(self.server.hub.snapshot())
                 self._reply(200, PROMETHEUS_CONTENT_TYPE,
@@ -243,6 +308,25 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._reply(404, "text/plain", b"not found\n")
         except Exception as e:  # pragma: no cover - scrape never kills run
+            try:
+                self._reply(500, "text/plain",
+                            f"telemetry error: {e}\n".encode("utf-8"))
+            except Exception:
+                pass
+
+    def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        try:
+            url = urlparse(self.path)
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            body = self.rfile.read(max(0, min(length, 1 << 20)))
+            if not self._dispatch_custom(
+                    getattr(self.server, "post_routes", {}), url,
+                    body=body):
+                self._reply(404, "text/plain", b"not found\n")
+        except Exception as e:  # pragma: no cover - intake never kills run
             try:
                 self._reply(500, "text/plain",
                             f"telemetry error: {e}\n".encode("utf-8"))
@@ -280,6 +364,19 @@ class TelemetryServer:
         self._httpd = None
         self._thread = None
         self.port = 0
+        # pluggable endpoints (the serve daemon's HTTP intake): shared
+        # dicts so add_route works before AND after start()
+        self._get_routes: dict = {}
+        self._post_routes: dict = {}
+
+    def add_route(self, method: str, path: str, fn) -> None:
+        """Mount a handler at ``path`` ("GET"/"POST").  A path ending
+        "/" is a prefix route; the remainder arrives as ``tail=``.
+        Handlers receive ``query=`` (parsed), ``body=`` (POST bytes) and
+        return ``(status_code, payload)``."""
+        routes = (self._post_routes if method.upper() == "POST"
+                  else self._get_routes)
+        routes[path] = fn
 
     def _status(self) -> dict:
         if self._status_fn is None:
@@ -297,6 +394,8 @@ class TelemetryServer:
         httpd.hub = self.hub
         httpd.status = self._status
         httpd.event_schema = EVENT_SCHEMA
+        httpd.get_routes = self._get_routes
+        httpd.post_routes = self._post_routes
         self._httpd = httpd
         self.port = httpd.server_address[1]
         # a tight poll keeps stop() latency ~50ms worst-case — teardown

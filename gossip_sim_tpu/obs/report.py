@@ -113,6 +113,7 @@ REQUIRED_KEYS = {
     "capacity": dict,
     "node_health": dict,
     "telemetry": dict,
+    "serve": dict,
 }
 
 
@@ -259,6 +260,7 @@ def build_run_report(config, registry, *, stats: dict | None = None,
                 int(registry.counter("resilience/fallback_units")),
         },
         "telemetry": _telemetry_section(info, registry),
+        "serve": _serve_section(info),
     })
     return report
 
@@ -314,6 +316,22 @@ def _node_health_section(info: dict) -> dict:
                 "source": "", "metrics": {}}
     except Exception:  # pragma: no cover - report must never kill a run
         return {"enabled": False, "metrics": {}}
+
+
+def _serve_section(info: dict) -> dict:
+    """Gossip-as-a-service section (serve/, ISSUE 20): lane occupancy +
+    admission counters the daemon stamps into registry info.  Non-serve
+    runs still carry the section (enabled=False) so the REQUIRED-key
+    schema holds on every report."""
+    try:
+        section = info.get("serve")
+        if section:
+            return dict(section)
+        return {"enabled": False, "lanes": 0, "busy": 0, "queued": 0,
+                "received": 0, "admitted": 0, "rejected": 0,
+                "completed": 0}
+    except Exception:  # pragma: no cover - report must never kill a run
+        return {"enabled": False}
 
 
 def _compilation_cache_section(info: dict) -> dict:

@@ -50,6 +50,11 @@ log = logging.getLogger("gossip_sim_tpu.obs")
 #: schema tag carried by every event record (JSONL event log + /events)
 EVENT_SCHEMA = "gossip-sim-tpu/events/v1"
 
+#: v2 extends v1 with the serve lifecycle (ISSUE 20); only records whose
+#: event type is serve-specific carry the v2 tag, so a non-serve run
+#: still writes a pure v1 log and every v1 consumer keeps validating
+EVENT_SCHEMA_V2 = "gossip-sim-tpu/events/v2"
+
 #: schema tag carried by every hub snapshot (/metrics + tests)
 TELEMETRY_SCHEMA = "gossip-sim-tpu/telemetry/v1"
 
@@ -71,6 +76,20 @@ EVENT_TYPES = frozenset({
     "influx_spool",       # sender spooled points to disk (points)
     "influx_drop",        # sender dropped points (points)
 })
+
+#: serve lifecycle events introduced by the v2 registry (gossip-as-a-
+#: service daemon, serve/).  Kept separate from the v1 set so the v1
+#: closed-world check stays exactly as strict as it shipped.
+SERVE_EVENT_TYPES = frozenset({
+    "request_received",   # intake accepted a request spec (request, tenant)
+    "request_admitted",   # scheduler spliced it into a lane (lane)
+    "request_rejected",   # admission refused it (reason, predicted_bytes)
+    "request_completed",  # lane retired; result + report durable
+    "lane_evicted",       # lane freed (retire/drain) and re-admittable
+})
+
+#: event types the v2 schema admits (superset of v1)
+EVENT_TYPES_V2 = EVENT_TYPES | SERVE_EVENT_TYPES
 
 #: ring-buffer depth backing /events (independent of file logging)
 RING_DEPTH = 1024
@@ -153,7 +172,10 @@ class TelemetryHub:
         try:
             with self._lock:
                 self._seq += 1
-                rec = {"schema": EVENT_SCHEMA, "seq": self._seq,
+                schema = (EVENT_SCHEMA_V2
+                          if event_type in SERVE_EVENT_TYPES
+                          else EVENT_SCHEMA)
+                rec = {"schema": schema, "seq": self._seq,
                        "ts": round(time.time(), 6), "ev": str(event_type),
                        "run": self._run_fp if run is None else str(run)}
                 if unit is not None:
@@ -266,6 +288,7 @@ class TelemetryHub:
             "health": _health_view(info),
             "memwatch": _memwatch_view(),
             "influx": polled.get("influx", {}),
+            "serve": polled.get("serve", {}),
             "events": events,
         }
         return out
@@ -338,9 +361,14 @@ def validate_event(rec) -> list:
         elif not isinstance(rec[key], types):
             problems.append(f"key {key}: expected {types}, got "
                             f"{type(rec[key]).__name__}")
-    if rec.get("schema") != EVENT_SCHEMA:
-        problems.append(f"unknown schema: {rec.get('schema')!r}")
-    if "ev" in rec and rec["ev"] not in EVENT_TYPES:
+    schema = rec.get("schema")
+    if schema not in (EVENT_SCHEMA, EVENT_SCHEMA_V2):
+        problems.append(f"unknown schema: {schema!r}")
+    # closed-world type check per schema generation: a v1 record must
+    # carry a v1 type (serve events tagged v1 are a bug, not forward
+    # compatibility), a v2 record anything the v2 registry admits
+    admitted = EVENT_TYPES if schema == EVENT_SCHEMA else EVENT_TYPES_V2
+    if "ev" in rec and rec["ev"] not in admitted:
         problems.append(f"unknown event type: {rec['ev']!r}")
     if "unit" in rec and not isinstance(rec["unit"], int):
         problems.append("unit must be int")

@@ -1,0 +1,19 @@
+"""Gossip-as-a-service: the continuous-batching scenario daemon
+(ISSUE 20).  See daemon.py for the architecture; request.py for the
+request schema; admission.py for the ledger-driven admission contract;
+intake.py for the HTTP + spool intake surfaces."""
+
+from .admission import AdmissionController, RejectedRequest
+from .daemon import ServeDaemon, block_rounds, run_serve
+from .request import SERVE_KNOB_FIELDS, ScenarioRequest, parse_request
+
+__all__ = [
+    "AdmissionController",
+    "RejectedRequest",
+    "SERVE_KNOB_FIELDS",
+    "ScenarioRequest",
+    "ServeDaemon",
+    "block_rounds",
+    "parse_request",
+    "run_serve",
+]

@@ -1,0 +1,146 @@
+"""Ledger-driven admission control for the serve daemon (ISSUE 20).
+
+Every scenario request is priced **before** it touches the device, with
+the same closed-form capacity ledger the planner trusts
+(:func:`gossip_sim_tpu.obs.capacity.predict_request_bytes` — exactness
+proven in tests/test_capacity.py).  Against a ``--serve-memory-budget``:
+
+* ``predicted > budget``                  -> **413**, permanently: the
+  request can never fit, the reply carries the predicted and available
+  byte counts so the client can resize instead of retry.
+* ``predicted > budget - bytes_in_use``   -> queued: it fits the machine
+  but not the moment; it waits for lanes to retire.
+* queue at ``--serve-max-queue``          -> **429**: backpressure, try
+  later.
+
+Rejections therefore cost zero device allocations — the 413/429 path
+returns before any JAX call (serve_smoke gate b checks
+``jax.live_arrays()`` is undisturbed).
+
+Fairness is FIFO **per tenant** with round-robin across tenants: one
+tenant spraying requests cannot starve another — each scheduling pass
+the cursor advances to the next tenant with a non-empty queue, and
+within a tenant order of arrival is preserved.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+
+from .request import ScenarioRequest
+
+
+class RejectedRequest(Exception):
+    """Admission refusal carrying the HTTP status + ledger detail."""
+
+    def __init__(self, code: int, reason: str, detail: dict | None = None):
+        super().__init__(reason)
+        self.code = int(code)
+        self.reason = reason
+        self.detail = dict(detail or {})
+
+    def payload(self) -> dict:
+        return {"error": self.reason, "code": self.code, **self.detail}
+
+
+class AdmissionController:
+    """Budget accounting + per-tenant FIFO queues (not thread-safe; the
+    daemon serializes access under its own lock)."""
+
+    def __init__(self, budget_bytes: int = 0, max_queue: int = 64):
+        self.budget_bytes = int(budget_bytes)      # 0 = unmetered
+        self.max_queue = int(max_queue)
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        self._rr: list = []                        # tenant round-robin ring
+        self._rr_idx = 0
+        self._in_use = 0                           # bytes held by running lanes
+        self.counters = {"received": 0, "admitted": 0, "rejected": 0,
+                         "completed": 0}
+        self.tenants_admitted: dict = {}
+        self.tenants_rejected: dict = {}
+
+    # -- introspection -------------------------------------------------
+    def queue_depth(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def bytes_in_use(self) -> int:
+        return self._in_use
+
+    def queued_bytes(self) -> int:
+        return sum(r.predicted_bytes for q in self._queues.values()
+                   for r in q)
+
+    def available_bytes(self) -> int:
+        """Budget headroom after running + queued commitments (what a 413
+        reply reports so the client sees the real free pool)."""
+        if self.budget_bytes <= 0:
+            return -1
+        return max(0, self.budget_bytes - self._in_use - self.queued_bytes())
+
+    # -- intake --------------------------------------------------------
+    def submit(self, req: ScenarioRequest) -> str:
+        """Price and enqueue one request.  Returns ``"queued"`` or raises
+        :class:`RejectedRequest` (413 over-budget / 429 queue-full)
+        without any device-side effect."""
+        self.counters["received"] += 1
+        if self.budget_bytes > 0 and req.predicted_bytes > self.budget_bytes:
+            self._note_rejected(req.tenant)
+            raise RejectedRequest(
+                413, "request exceeds the daemon memory budget",
+                {"id": req.id, "predicted_bytes": req.predicted_bytes,
+                 "budget_bytes": self.budget_bytes,
+                 "available_bytes": self.available_bytes()})
+        if self.queue_depth() >= self.max_queue:
+            self._note_rejected(req.tenant)
+            raise RejectedRequest(
+                429, "admission queue is full",
+                {"id": req.id, "queue_depth": self.queue_depth(),
+                 "max_queue": self.max_queue})
+        if req.tenant not in self._queues:
+            self._queues[req.tenant] = deque()
+            self._rr.append(req.tenant)
+        req.status = "queued"
+        self._queues[req.tenant].append(req)
+        return "queued"
+
+    def _note_rejected(self, tenant: str) -> None:
+        self.counters["rejected"] += 1
+        self.tenants_rejected[tenant] = self.tenants_rejected.get(tenant, 0) + 1
+
+    def note_invalid(self, tenant: str = "invalid") -> None:
+        """Count a request that failed validation before pricing (bad
+        JSON, unknown knob, out-of-range value) — a 400, not a 413."""
+        self.counters["received"] += 1
+        self._note_rejected(tenant)
+
+    # -- scheduling ----------------------------------------------------
+    def next_admission(self):
+        """Pop the next runnable request (round-robin over tenants, FIFO
+        within one) if the moment's budget headroom covers it; None when
+        nothing can start right now."""
+        if not self._rr:
+            return None
+        n = len(self._rr)
+        for off in range(n):
+            tenant = self._rr[(self._rr_idx + off) % n]
+            q = self._queues.get(tenant)
+            if not q:
+                continue
+            req = q[0]
+            if (self.budget_bytes > 0
+                    and self._in_use + req.predicted_bytes > self.budget_bytes):
+                continue  # fits the machine, not the moment — hold FIFO order
+            q.popleft()
+            self._rr_idx = (self._rr_idx + off + 1) % n
+            self._in_use += req.predicted_bytes
+            req.status = "running"
+            self.counters["admitted"] += 1
+            self.tenants_admitted[tenant] = (
+                self.tenants_admitted.get(tenant, 0) + 1)
+            return req
+        return None
+
+    def complete(self, req: ScenarioRequest) -> None:
+        """Release a finished (or failed) request's byte reservation."""
+        self._in_use = max(0, self._in_use - req.predicted_bytes)
+        self.counters["completed"] += 1

@@ -1,0 +1,685 @@
+"""The continuous-batching scenario daemon (ISSUE 20 tentpole).
+
+One process holds ONE warm lane-batched executable — ``--serve-lanes K``
+dynamically-membered lanes over :func:`engine.run_rounds_lanes_dyn` —
+and serves scenario requests for the daemon's fixed compile geometry
+(cluster, fanout, active-set size, mode, iteration count) continuously:
+
+* requests arrive over HTTP (``POST /submit`` on the PR 18 telemetry
+  plane, intake.py) or a watched ``--serve-spool-dir``;
+* admission is **ledger-driven** (admission.py): every request is priced
+  with the closed-form capacity ledger before it touches the device —
+  over-budget requests 413 with the predicted and available byte counts
+  and cost zero device allocations;
+* admitted requests splice into free lanes at block boundaries
+  (``--serve-block-rounds``) while co-resident lanes keep running —
+  continuous batching, the Orca-style iteration-level scheduling idea
+  applied to simulation scans.  Steady-state admissions re-enter the one
+  warm executable with ZERO recompiles (the shapes never change); the
+  single documented exception is a request that widens the impairment
+  gate union (merge_lane_statics), which recompiles once and is flagged
+  on the ``request_admitted`` event;
+* each retiring lane harvests through the UNCHANGED per-sim paths
+  (cli._harvest_lane / _finalize_sim_stats), so a request's parity
+  snapshot and deterministic Influx wire lines are byte-identical to the
+  same config run solo through run_lane_sweep (tools/serve_smoke.py
+  gate a);
+* completions journal through resilience.RunJournal: SIGTERM drains
+  in-flight lanes, commits them, and exits with the resumable code 75;
+  a restart replays committed results verbatim and re-admits every
+  journaled-but-uncommitted request from the intake sidecar.
+
+The daemon runs on the MAIN thread inside cli.main()'s signal_guard;
+HTTP intake handlers run on the exporter's threads and only touch the
+admission queues under the daemon lock — the device is driven by exactly
+one thread, always.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..obs import get_registry
+from ..obs import telemetry as _telemetry
+from ..obs.capacity import parse_size, predict_request_bytes
+from ..resilience import (InfluxTee, ResumableInterrupt,
+                          replay_influx_lines, restore_pubkey_counter,
+                          shutdown_requested, stats_unit_payload)
+from ..sinks.influx import deterministic_wire_lines
+from .admission import AdmissionController, RejectedRequest
+from .request import parse_request
+
+log = logging.getLogger(__name__)
+
+
+def block_rounds(total: int, requested: int) -> int:
+    """The scheduler tick: the largest divisor of ``total`` that is
+    <= ``requested``.  Divisibility means every lane's admission offset
+    stays a block multiple, so lanes only ever retire exactly at a block
+    boundary and the lane count per dispatch is constant."""
+    b = max(1, min(int(requested), int(total)))
+    while total % b:
+        b -= 1
+    return b
+
+
+class _NullQueue:
+    """Line sink for influx-less daemons: the InfluxTee still captures
+    each request's wire lines for its result payload, the points
+    themselves go nowhere."""
+
+    def push_back(self, dp) -> None:
+        pass
+
+    def __len__(self):
+        return 0
+
+
+class ServeDaemon:
+    """State + scheduling for one serve run (see module docstring)."""
+
+    def __init__(self, config, json_rpc_url, dp_queue, start_ts,
+                 telemetry_server):
+        from .. import cli  # deferred: cli imports this package lazily too
+        self._cli = cli
+        self.config = config
+        self.dp_queue = dp_queue
+        self.start_ts = start_ts
+        self.telemetry_server = telemetry_server
+
+        self.K = max(1, int(config.serve_lanes))
+        self.total = int(config.gossip_iterations)
+        self.warm = min(config.warm_up_rounds, self.total)
+        self.block = block_rounds(self.total, config.serve_block_rounds)
+        budget = (parse_size(config.serve_memory_budget)
+                  if config.serve_memory_budget else 0)
+        self.admission = AdmissionController(budget, config.serve_max_queue)
+        self.lock = threading.RLock()
+        self.requests: dict = {}        # id -> ScenarioRequest
+        self.results: dict = {}         # id -> result payload
+        from ..stats.gossip_stats import GossipStatsCollection
+        self.collection = GossipStatsCollection()
+
+        self.lanes: list = [None] * self.K   # per-lane run table or None
+        self.states = None                   # [K, O, ...] SimState
+        self.tables = None
+        self._device_ready = False
+        self._seq = 0
+        self._completions = 0
+        self._draining = False
+        self._tick = 0
+        self._last_block_wall = 0.0
+        self._idle_since = time.time()
+
+        # crash-recovery plane: journal units are COMPLETIONS (commit
+        # order), the intake sidecar records ADMISSION order — together
+        # they reconstruct exactly the uncommitted work set on restart
+        self.journal = cli._open_journal(config, "serve",
+                                         {"serve_lanes": self.K})
+        self.intake_path = (self.journal.path + ".intake"
+                            if self.journal is not None else "")
+        self.feed = InfluxTee(dp_queue if dp_queue is not None
+                              else _NullQueue())
+        if self.journal is not None:
+            # synthetic clusters advance the global pubkey counter per
+            # load; the resumed run must see the counter position the
+            # interrupted run recorded (same contract as run_lane_sweep)
+            restore_pubkey_counter(self.journal.header_pubkey_counter())
+
+        # the cluster is resolved ONCE, host-side, at startup — it both
+        # fixes the compile geometry and gives pricing its N before any
+        # device contact
+        self.accounts, self.source_label = cli.load_cluster_accounts(
+            config, json_rpc_url)
+        from ..identity import NodeIndex
+        self.stakes = dict(self.accounts)
+        self.index = NodeIndex.from_stakes(self.accounts)
+        self.N = len(self.index)
+        self.base_params = cli._engine_params(config, self.N).validate()
+        self.static = self.base_params.static_part()
+
+        # intake goes live as soon as the daemon can answer (the
+        # telemetry port binds earlier in main(); until this point
+        # /submit 404s, so clients retry briefly after discovery)
+        _telemetry.get_hub().set_provider("serve", self.serve_view)
+        if telemetry_server is not None:
+            from .intake import mount_routes
+            mount_routes(telemetry_server, self)
+
+    # -- intake (called from HTTP/exporter threads AND the main loop) --
+    def submit_raw(self, raw, source: str = "http"):
+        """Validate + price + enqueue one submitted spec.  Returns
+        ``(http_code, payload)``; rejections return before any device
+        call."""
+        from ..engine import check_lane_knobs, merge_lane_statics
+        with self.lock:
+            self._seq += 1
+            default_id = f"req-{self._seq:04d}"
+            _telemetry.emit_event("request_received", source=source)
+            try:
+                req = parse_request(raw, self.config, default_id=default_id)
+                req.source = source
+                if req.id in self.requests:
+                    raise ValueError(f"duplicate request id {req.id!r}")
+                if req.origin_rank > self.N:
+                    raise ValueError(
+                        f"origin_rank {req.origin_rank} exceeds the "
+                        f"daemon cluster size {self.N}")
+                if self._draining:
+                    raise ValueError(
+                        "daemon is draining (shutdown requested); "
+                        "resubmit after restart")
+                rc = req.request_config(self.config)
+                params = self._cli._engine_params(rc, self.N).validate()
+                # geometry check: the request must be servable by the
+                # (possibly gate-widened) daemon static
+                merged = merge_lane_statics([self.static,
+                                             params.static_part()])
+                check_lane_knobs(merged, [params.knob_values()])
+            except ValueError as e:
+                self.admission.note_invalid()
+                _telemetry.emit_event("request_rejected", code=400,
+                                      reason=str(e)[:200])
+                return 400, {"error": str(e), "code": 400}
+            req.predicted_bytes = predict_request_bytes(params, 1)
+            try:
+                self.admission.submit(req)
+            except RejectedRequest as e:
+                _telemetry.emit_event(
+                    "request_rejected", id=req.id, tenant=req.tenant,
+                    code=e.code, reason=e.reason,
+                    predicted_bytes=req.predicted_bytes)
+                return e.code, e.payload()
+            self.requests[req.id] = req
+            if source != "journal-intake":
+                self._append_intake(req)
+            return 200, {"id": req.id, "status": "queued",
+                         "predicted_bytes": req.predicted_bytes,
+                         "queue_depth": self.admission.queue_depth()}
+
+    def get_result(self, rid: str):
+        with self.lock:
+            if rid in self.results:
+                return 200, self.results[rid]
+            req = self.requests.get(rid)
+            if req is None:
+                return 404, {"error": f"unknown request id {rid!r}",
+                             "code": 404}
+            return 202, {"id": rid, "status": req.status,
+                         "lane": req.lane,
+                         "rounds_done": req.rounds_done,
+                         "total_rounds": self.total}
+
+    def _append_intake(self, req) -> None:
+        if not self.intake_path:
+            return
+        try:
+            with open(self.intake_path, "a") as f:
+                f.write(json.dumps(req.spec()) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:  # degraded: lose restart re-admission only
+            log.warning("serve: intake sidecar append failed: %s", e)
+
+    # -- live view -----------------------------------------------------
+    def serve_view(self) -> dict:
+        """The live serve section: hub provider (``/metrics`` gauges +
+        ``/status``), ``GET /serve``, and the run report's serve key all
+        read this one dict."""
+        with self.lock:
+            lanes = []
+            for i, l in enumerate(self.lanes):
+                if l is None:
+                    lanes.append({"lane": i, "busy": False})
+                    continue
+                req = l["req"]
+                remaining = self.total - req.rounds_done
+                eta = (round(remaining / self.block
+                             * self._last_block_wall, 3)
+                       if self._last_block_wall > 0 else -1.0)
+                lanes.append({"lane": i, "busy": True, "id": req.id,
+                              "tenant": req.tenant,
+                              "rounds_done": req.rounds_done,
+                              "total_rounds": self.total, "eta_s": eta})
+            a = self.admission
+            return {
+                "enabled": True,
+                "lanes": self.K,
+                "busy": sum(1 for l in self.lanes if l is not None),
+                "queued": a.queue_depth(),
+                "block_rounds": self.block,
+                "draining": self._draining,
+                "received": a.counters["received"],
+                "admitted": a.counters["admitted"],
+                "rejected": a.counters["rejected"],
+                "completed": a.counters["completed"],
+                "budget_bytes": a.budget_bytes,
+                "bytes_in_use": a.bytes_in_use(),
+                "tenants_admitted": dict(a.tenants_admitted),
+                "tenants_rejected": dict(a.tenants_rejected),
+                "lane_detail": lanes,
+            }
+
+    # -- device-side scheduling (main thread only) ---------------------
+    def _ensure_device(self) -> None:
+        if self._device_ready:
+            return
+        import jax
+
+        from ..engine import make_cluster_tables
+        cli = self._cli
+        reg = get_registry()
+        cli._enable_compilation_cache(self.config)
+        with reg.span("engine/tables"):
+            self.tables = make_cluster_tables(
+                self.index.stakes.astype(np.int64))
+        reg.set_info("platform", jax.devices()[0].platform)
+        reg.set_info("origin_batch", 1)
+        reg.set_info("sweep_lanes", self.K)
+        cli._note_capacity_ledger(self.config, self.base_params,
+                                  lanes=self.K)
+        self._device_ready = True
+
+    def _admit(self, req, lane: int) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..engine import (broadcast_state, init_state,
+                              merge_lane_statics, splice_lane_state)
+        cli = self._cli
+        self._ensure_device()
+        rc = req.request_config(self.config)
+        sweep_point = cli._stepped_sweep_config(rc, 0, [rc.origin_rank])
+        params = cli._engine_params(rc, self.N).validate()
+        merged = merge_lane_statics([self.static, params.static_part()])
+        widened = merged != self.static
+        self.static = merged
+        origin = cli.find_nth_largest_node(req.origin_rank,
+                                           list(self.accounts.items()))
+        origin_pubkey = origin[0]
+        origin_idx = self.index.index_of(origin_pubkey)
+        reg = get_registry()
+        with reg.span("engine/init"):
+            st = init_state(jax.random.PRNGKey(req.seed), self.tables,
+                            jnp.asarray([origin_idx], dtype=jnp.int32),
+                            params)
+            jax.block_until_ready(st)
+        if self.states is None:
+            self.states = broadcast_state(st, self.K)
+        else:
+            self.states = splice_lane_state(self.states, lane, st)
+        req.status = "running"
+        req.lane = lane
+        req.rounds_done = 0
+        self.lanes[lane] = {"req": req, "rc": rc,
+                            "sweep_point": sweep_point, "params": params,
+                            "knobs": params.knob_values(),
+                            "origin_idx": origin_idx,
+                            "origin_pubkey": origin_pubkey, "chunks": []}
+        _telemetry.emit_event("request_admitted", id=req.id,
+                              tenant=req.tenant, lane=lane,
+                              predicted_bytes=req.predicted_bytes,
+                              gate_union=bool(widened))
+        log.info("serve: admitted %s (tenant %s) into lane %d%s",
+                 req.id, req.tenant, lane,
+                 " [impairment gate union widened: one recompile]"
+                 if widened else "")
+
+    def _admit_ready(self) -> None:
+        for lane in range(self.K):
+            if self.lanes[lane] is not None:
+                continue
+            req = self.admission.next_admission()
+            if req is None:
+                return
+            self._admit(req, lane)
+
+    def _dispatch_block(self) -> None:
+        import jax
+
+        from ..engine import run_rounds_lanes_dyn, stack_knobs, stack_origins
+        cli = self._cli
+        reg = get_registry()
+        with self.lock:
+            active = [i for i, l in enumerate(self.lanes) if l is not None]
+            fill = self.lanes[active[0]]
+            slots = [self.lanes[i] or fill for i in range(self.K)]
+            kstack = stack_knobs([s["knobs"] for s in slots])
+            ostack = stack_origins([[s["origin_idx"]] for s in slots])
+            start_its = [self.lanes[i]["req"].rounds_done
+                         if self.lanes[i] is not None else 0
+                         for i in range(self.K)]
+            static, tables, states = self.static, self.tables, self.states
+
+        t_blk = time.perf_counter()
+        cm, _counted = cli._engine_call_span(reg)
+
+        def _go(st):
+            sts, rws = run_rounds_lanes_dyn(static, tables, ostack, st,
+                                            kstack, self.block, start_its,
+                                            detail=True)
+            return sts, jax.tree_util.tree_map(np.asarray, rws)
+
+        with cm:
+            new_states, rows = cli._dispatch_supervised(
+                self.config, f"serve-block-{self._tick}", _go, states)
+        self._last_block_wall = time.perf_counter() - t_blk
+        self._tick += 1
+
+        with self.lock:
+            self.states = new_states
+            for i in active:
+                l = self.lanes[i]
+                l["chunks"].append({k: v[:, i] for k, v in rows.items()})
+                l["req"].rounds_done += self.block
+        cli._push_sim_perf_point(self.dp_queue, 0, self.start_ts,
+                                 self._last_block_wall, self.block,
+                                 len(active))
+
+    def _retire_finished(self) -> None:
+        for lane, l in enumerate(self.lanes):
+            if l is None or l["req"].rounds_done < self.total:
+                continue
+            self._complete(lane, l)
+            self.lanes[lane] = None
+
+    def _complete(self, lane: int, l: dict) -> None:
+        from ..engine import lane_state
+        from ..stats.gossip_stats import GossipStats
+        from ..constants import VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS
+        cli = self._cli
+        reg = get_registry()
+        req, rc = l["req"], l["rc"]
+        # stray non-request lines (perf points etc.) were already
+        # live-forwarded; clear the unit buffer so the harvest below
+        # captures exactly this request's wire lines
+        self.feed.take_unit_lines()
+        lrows = {k: np.concatenate([c[k] for c in l["chunks"]], axis=0)
+                 for k in l["chunks"][0]}
+        stats = GossipStats()
+        stats.set_simulation_parameters(rc)
+        stats.set_origin(l["origin_pubkey"])
+        stats.initialize_message_stats(self.stakes)
+        stats.build_validator_stake_distribution_histogram(
+            VALIDATOR_STAKE_DISTRIBUTION_NUM_BUCKETS, self.stakes)
+        measured = self.total - self.warm
+        with reg.span("stats/harvest"):
+            cli._harvest_lane(rc, l["sweep_point"], stats, lrows,
+                              lane_state(self.states, lane), l["params"],
+                              self.index, self.stakes, l["origin_pubkey"],
+                              self.feed, 0, req.start_ts, self.warm,
+                              self.total, len(self.accounts),
+                              self.source_label)
+            cli._finalize_sim_stats(l["sweep_point"][0], stats,
+                                    self.stakes, self.collection,
+                                    self.feed, 0, req.start_ts)
+        reg.add("origin_iters", measured)
+        reg.add("messages_delivered",
+                int(lrows["delivered"][self.warm:].sum()))
+        lines = self.feed.take_unit_lines()
+        payload = stats_unit_payload(stats)
+        result = {
+            "id": req.id, "tenant": req.tenant, "status": "done",
+            "spec": req.spec(), "lane": lane,
+            "predicted_bytes": req.predicted_bytes,
+            "snapshot": payload["snapshot"],
+            "lines": lines,
+            # a journaled line is one POINT body — possibly multi-line,
+            # timestamps included (replay needs it verbatim) — so split
+            # before normalizing to the parity wire form
+            "deterministic_lines": deterministic_wire_lines(
+                [ln for body in lines for ln in body.splitlines()]),
+            "stats": {
+                "coverage_mean": round(float(stats.coverage_stats.mean),
+                                       6),
+                "rmr_mean": round(float(stats.rmr_stats.mean), 6),
+            },
+            "wall_s": round(time.time() - req.submitted_ts, 3)
+            if req.submitted_ts else 0.0,
+        }
+        unit = self._completions
+        if self.journal is not None:
+            self.journal.commit(unit, {"request": req.spec(),
+                                       "sims": [[unit, payload]],
+                                       "lines": lines})
+        self._completions += 1
+        req.status = "done"
+        req.lane = -1
+        self.admission.complete(req)
+        self.results[req.id] = result
+        _telemetry.emit_event("request_completed", id=req.id,
+                              tenant=req.tenant, lane=lane,
+                              rounds=self.total,
+                              coverage_mean=result["stats"]
+                              ["coverage_mean"])
+        _telemetry.emit_event("lane_evicted", lane=lane, id=req.id,
+                              reason="completed")
+        log.info("serve: completed %s (tenant %s, lane %d, coverage "
+                 "%.4f)", req.id, req.tenant, lane,
+                 result["stats"]["coverage_mean"])
+        self._write_request_report(req, rc, result)
+        self._spool_result(req, result)
+
+    def _write_request_report(self, req, rc, result) -> None:
+        """Per-request run report through the unchanged obs/report.py
+        schema: ``<run-report-path stem>.req-<id>.json``."""
+        if not self.config.run_report_path:
+            return
+        try:
+            from ..obs.report import (build_run_report,
+                                      validate_run_report,
+                                      write_run_report)
+            self._cli._sync_cache_counters()
+            reg = get_registry()
+            reg.set_info("serve", self.serve_view())
+            report = build_run_report(rc, reg, stats=result["stats"])
+            problems = validate_run_report(report)
+            if problems:
+                log.warning("WARNING: per-request report failed schema "
+                            "self-check: %s", problems)
+            base, ext = os.path.splitext(self.config.run_report_path)
+            path = f"{base}.req-{req.id}{ext or '.json'}"
+            write_run_report(path, report)
+            log.info("serve: request report written to %s", path)
+        except Exception as e:  # telemetry must never kill the daemon
+            log.warning("serve: per-request run report failed: %s", e)
+
+    def _spool_result(self, req, result) -> None:
+        if req.source != "spool" or not self.config.serve_spool_dir:
+            return
+        try:
+            path = os.path.join(self.config.serve_spool_dir,
+                                f"{req.id}.result.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(result, f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("serve: spool result write failed: %s", e)
+
+    # -- crash recovery ------------------------------------------------
+    def _replay_journal(self) -> None:
+        if self.journal is None:
+            return
+        k = self.journal.committed_prefix()
+        for unit in range(k):
+            payload = self.journal.records[unit]
+            spec = payload.get("request") or {}
+            req = parse_request(spec, self.config,
+                                default_id=str(spec.get("id")
+                                               or f"replay-{unit}"))
+            req.source = "journal"
+            req.status = "done"
+            sims = payload.get("sims") or []
+            stats = None
+            if sims:
+                stats = self._cli._replay_finished_sim(
+                    sims[0][1], req.request_config(self.config),
+                    self.stakes, self.collection)
+            lines = list(payload.get("lines", []))
+            # verbatim wire replay to the LIVE queue (dedup at the
+            # endpoint on identical series+timestamp), never the tee —
+            # these lines are already journaled
+            replay_influx_lines(self.dp_queue, lines)
+            a = self.admission
+            a.counters["received"] += 1
+            a.counters["admitted"] += 1
+            a.counters["completed"] += 1
+            a.tenants_admitted[req.tenant] = (
+                a.tenants_admitted.get(req.tenant, 0) + 1)
+            self.requests[req.id] = req
+            result = {
+                "id": req.id, "tenant": req.tenant, "status": "done",
+                "spec": req.spec(), "replayed": True,
+                "snapshot": (sims[0][1].get("snapshot") if sims
+                             else None),
+                "lines": lines,
+                "deterministic_lines": deterministic_wire_lines(lines),
+            }
+            if stats is not None and not stats.is_empty():
+                result["stats"] = {
+                    "coverage_mean":
+                        round(float(stats.coverage_stats.mean), 6),
+                    "rmr_mean": round(float(stats.rmr_stats.mean), 6),
+                }
+            self.results[req.id] = result
+            self._completions += 1
+        if k:
+            log.info("serve resume: %d committed request(s) replayed "
+                     "verbatim from the journal", k)
+        # re-admit what the interrupted daemon accepted but never
+        # committed, in the original admission order
+        if not self.intake_path or not os.path.exists(self.intake_path):
+            return
+        try:
+            with open(self.intake_path) as f:
+                intake_lines = f.read().splitlines()
+        except OSError as e:
+            log.warning("serve resume: intake sidecar unreadable: %s", e)
+            return
+        readmitted = 0
+        for line in intake_lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                spec = json.loads(line)
+            except ValueError:
+                continue
+            if str(spec.get("id")) in self.requests:
+                continue
+            code, resp = self.submit_raw(spec, source="journal-intake")
+            if code == 200:
+                readmitted += 1
+            else:
+                log.warning("serve resume: could not re-admit %s: %s",
+                            spec.get("id"), resp)
+        if readmitted:
+            log.info("serve resume: re-admitted %d uncommitted "
+                     "request(s) from the intake sidecar", readmitted)
+
+    # -- the loop ------------------------------------------------------
+    def run(self) -> dict:
+        reg = get_registry()
+        reg.set_info("run_path", "serve")
+        self._replay_journal()
+        log.info("##### GOSSIP-AS-A-SERVICE: %d lane(s) x %d rounds "
+                 "(block %d), n=%d, budget %s #####", self.K, self.total,
+                 self.block, self.N,
+                 self.config.serve_memory_budget or "unmetered")
+        if self.telemetry_server is not None:
+            log.info("serve: intake at http://127.0.0.1:%d/submit",
+                     self.telemetry_server.port)
+        try:
+            while True:
+                if shutdown_requested() and not self._draining:
+                    with self.lock:
+                        self._draining = True
+                        busy = sum(1 for l in self.lanes
+                                   if l is not None)
+                    log.warning("serve: shutdown requested — draining "
+                                "%d in-flight lane(s), admissions "
+                                "closed", busy)
+                with self.lock:
+                    if not self._draining:
+                        from .intake import scan_spool
+                        scan_spool(self)
+                        self._admit_ready()
+                    any_active = any(l is not None for l in self.lanes)
+                if any_active:
+                    self._dispatch_block()
+                    with self.lock:
+                        self._retire_finished()
+                        # backfill freed lanes immediately so the next
+                        # block runs full — unless a shutdown arrived
+                        # while this block ran (a commit's kill-after
+                        # hook included): drain must not admit NEW work,
+                        # only finish what is already on the device
+                        if not self._draining and not shutdown_requested():
+                            self._admit_ready()
+                elif self._draining:
+                    raise ResumableInterrupt(
+                        f"serve drained ({self._completions} request(s) "
+                        f"committed)")
+                else:
+                    time.sleep(0.05)
+                with self.lock:
+                    reg.set_info("serve", self.serve_view())
+                    busy = sum(1 for l in self.lanes if l is not None)
+                    queued = self.admission.queue_depth()
+                if busy or queued:
+                    self._idle_since = time.time()
+                if (self.config.serve_max_requests > 0
+                        and self._completions
+                        >= self.config.serve_max_requests
+                        and not busy):
+                    log.info("serve: --serve-max-requests %d reached; "
+                             "exiting", self.config.serve_max_requests)
+                    break
+                if (self.config.serve_idle_timeout_s > 0
+                        and not busy and not queued
+                        and time.time() - self._idle_since
+                        > self.config.serve_idle_timeout_s):
+                    log.info("serve: idle for %.1fs; exiting",
+                             self.config.serve_idle_timeout_s)
+                    break
+        finally:
+            with self.lock:
+                reg.set_info("serve", self.serve_view())
+            if self.journal is not None:
+                self.journal.close()
+        return self.summary()
+
+    def summary(self) -> dict:
+        a = self.admission
+        out = {
+            "requests_received": a.counters["received"],
+            "requests_admitted": a.counters["admitted"],
+            "requests_rejected": a.counters["rejected"],
+            "requests_completed": self._completions,
+            "lanes": self.K,
+            "block_rounds": self.block,
+        }
+        sims = [s for s in self.collection.collection if not s.is_empty()]
+        if sims:
+            out["coverage_mean"] = float(
+                np.mean([s.coverage_stats.mean for s in sims]))
+            out["rmr_mean"] = float(
+                np.mean([s.rmr_stats.mean for s in sims]))
+        return out
+
+
+def run_serve(config, json_rpc_url, dp_queue, start_ts,
+              telemetry_server) -> dict:
+    """cli.main()'s serve dispatch: build the daemon and run it on the
+    calling (main) thread until a terminal condition or a drain-and-exit
+    (ResumableInterrupt -> exit code 75 via main's existing handler)."""
+    daemon = ServeDaemon(config, json_rpc_url, dp_queue, start_ts,
+                         telemetry_server)
+    return daemon.run()

@@ -1,0 +1,82 @@
+"""Request intake surfaces for the serve daemon (ISSUE 20).
+
+Two intake paths, one admission pipeline:
+
+* **HTTP** — mounted onto the PR 18 telemetry exporter's pluggable
+  routes (obs/exporter.py), so one port serves scrapes AND submissions:
+
+  - ``POST /submit``  body = request JSON -> 200 ``{"id", "status"}`` /
+    400 invalid / 413 over-budget (with predicted + available bytes) /
+    429 queue full
+  - ``GET /result/<id>``  -> 200 finished result (parity snapshot +
+    deterministic wire lines) / 202 still queued or running / 404
+  - ``GET /serve``  -> the live serve view (lane occupancy, queue
+    depth, per-tenant counters, per-lane ETA)
+
+* **Spool** — a watched ``--serve-spool-dir``: drop ``<name>.json`` and
+  the daemon picks it up at the next block boundary (renamed to
+  ``.taken`` first, so each file is admitted exactly once), then writes
+  ``<id>.result.json`` on completion or ``<name>.rejected.json`` with
+  the refusal payload.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+
+def mount_routes(server, daemon) -> None:
+    """Mount the daemon's intake endpoints on a TelemetryServer."""
+
+    def _submit(query=None, body=b""):
+        return daemon.submit_raw(body or b"{}", source="http")
+
+    def _result(query=None, tail=""):
+        return daemon.get_result(tail.strip("/"))
+
+    def _view(query=None):
+        return 200, daemon.serve_view()
+
+    server.add_route("POST", "/submit", _submit)
+    server.add_route("GET", "/result/", _result)
+    server.add_route("GET", "/serve", _view)
+
+
+def scan_spool(daemon) -> None:
+    """One pass over the watched intake directory (called from the
+    daemon loop at block boundaries, under the daemon lock)."""
+    spool = daemon.config.serve_spool_dir
+    if not spool:
+        return
+    try:
+        names = sorted(os.listdir(spool))
+    except OSError as e:
+        log.warning("serve: spool dir unreadable: %s", e)
+        return
+    for name in names:
+        if (not name.endswith(".json") or name.endswith(".result.json")
+                or name.endswith(".rejected.json")):
+            continue
+        path = os.path.join(spool, name)
+        taken = path + ".taken"
+        try:
+            os.replace(path, taken)  # claim atomically: admit-once
+            with open(taken) as f:
+                raw = f.read()
+        except OSError:
+            continue  # raced away or unreadable; next pass decides
+        code, payload = daemon.submit_raw(raw, source="spool")
+        if code != 200:
+            log.warning("serve: spool request %s rejected (%s): %s",
+                        name, code, payload.get("error", payload))
+            try:
+                rej = os.path.join(spool, name[:-len(".json")]
+                                   + ".rejected.json")
+                with open(rej, "w") as f:
+                    json.dump(payload, f)
+            except OSError:
+                pass

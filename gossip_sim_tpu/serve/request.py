@@ -1,0 +1,160 @@
+"""Scenario request specs for the gossip-as-a-service daemon (ISSUE 20).
+
+A request is a JSON document submitted over ``POST /submit`` or dropped
+into the ``--serve-spool-dir`` as ``*.json``:
+
+    {"id": "r1", "tenant": "alice", "seed": 7, "origin_rank": 1,
+     "start_ts": "0",
+     "knobs": {"probability_of_rotation": 0.2, "packet_loss_rate": 0.05}}
+
+Every field is optional except that ``knobs`` keys must come from
+:data:`SERVE_KNOB_FIELDS` — the Config fields that map onto *traced*
+:class:`~gossip_sim_tpu.engine.params.EngineKnobs` leaves (plus the two
+impairment-window schedules), so any admissible request can ride the
+daemon's one warm executable.  Compile geometry (cluster size, fanout,
+active-set size, gossip mode, iteration count) is fixed by the daemon's
+base config: a knob that would change the static compile key is not a
+request parameter, it is a different daemon.
+
+The only statics a request may *implicitly* flip are the coarse
+impairment gates (has_loss/has_churn/has_partition): a loss-free daemon
+admitting its first lossy request widens the merged static via
+``merge_lane_statics`` — one documented recompile, counted on
+``engine/compiles`` (tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+
+#: Config fields a request may override — each maps to a traced
+#: EngineKnobs leaf (engine/params.py), so admission never changes the
+#: compile geometry (the impairment gates excepted, see module doc).
+SERVE_KNOB_FIELDS = frozenset({
+    "probability_of_rotation",
+    "prune_stake_threshold",
+    "min_ingress_nodes",
+    "packet_loss_rate",
+    "churn_fail_rate",
+    "churn_recover_rate",
+    "partition_at",
+    "heal_at",
+    "pull_fanout",
+    "pull_interval",
+    "pull_bloom_fp_rate",
+    "pull_request_cap",
+    "adaptive_switch_threshold",
+    "adaptive_switch_hysteresis",
+})
+
+#: knob fields carrying a probability (validated into [0, 1])
+_RATE_FIELDS = frozenset({
+    "probability_of_rotation", "prune_stake_threshold",
+    "packet_loss_rate", "churn_fail_rate", "churn_recover_rate",
+    "pull_bloom_fp_rate", "adaptive_switch_threshold",
+    "adaptive_switch_hysteresis",
+})
+
+_INT_FIELDS = frozenset({
+    "min_ingress_nodes", "partition_at", "heal_at",
+    "pull_fanout", "pull_interval", "pull_request_cap",
+})
+
+
+@dataclass
+class ScenarioRequest:
+    """One validated scenario request plus its scheduling state."""
+
+    id: str
+    tenant: str = "default"
+    seed: int = 0
+    origin_rank: int = 1
+    knobs: dict = field(default_factory=dict)
+    start_ts: str = ""              # Influx start_time tag (the
+                                    # per-request attribution tag riding
+                                    # the unchanged PR 2 wire paths)
+    submitted_ts: float = 0.0
+    source: str = "http"            # http | spool | journal-intake
+    predicted_bytes: int = 0
+    status: str = "queued"          # queued | running | done | failed
+    lane: int = -1
+    rounds_done: int = 0
+
+    def spec(self) -> dict:
+        """The JSON-safe spec (what the intake log / journal persists —
+        enough to re-admit the request bit-exactly after a restart)."""
+        return {"id": self.id, "tenant": self.tenant, "seed": self.seed,
+                "origin_rank": self.origin_rank,
+                "knobs": dict(self.knobs), "start_ts": self.start_ts}
+
+    def request_config(self, base_config):
+        """The request's Config: the daemon base stepped by the knob
+        overrides, shaped like one solo lane-sweep point
+        (num_simulations=1) so the request feeds the exact stats/Influx
+        paths ``run_lane_sweep`` would solo — the serve_smoke parity
+        contract."""
+        return base_config.stepped(seed=self.seed,
+                                   origin_rank=self.origin_rank,
+                                   num_simulations=1, sweep_lanes=1,
+                                   checkpoint_path="", resume_path="",
+                                   **self.knobs)
+
+
+def parse_request(raw, base_config, *, default_id: str) -> ScenarioRequest:
+    """Validate one submitted spec (dict or JSON bytes/str) into a
+    :class:`ScenarioRequest`.  Raises ``ValueError`` with a
+    client-presentable message on any problem — unknown knob keys are an
+    error, not a warning, so a typo'd knob can never silently run the
+    base scenario."""
+    if isinstance(raw, (bytes, str)):
+        try:
+            raw = json.loads(raw)
+        except ValueError as e:
+            raise ValueError(f"request body is not JSON: {e}")
+    if not isinstance(raw, dict):
+        raise ValueError(f"request must be a JSON object, got "
+                         f"{type(raw).__name__}")
+    knobs = raw.get("knobs") or {}
+    if not isinstance(knobs, dict):
+        raise ValueError("knobs must be an object")
+    unknown = sorted(set(knobs) - SERVE_KNOB_FIELDS)
+    if unknown:
+        raise ValueError(
+            f"unknown knob field(s) {unknown}; a request may set: "
+            f"{sorted(SERVE_KNOB_FIELDS)}")
+    clean = {}
+    for k, v in knobs.items():
+        try:
+            clean[k] = int(v) if k in _INT_FIELDS else float(v)
+        except (TypeError, ValueError):
+            raise ValueError(f"knob {k}: expected a number, got {v!r}")
+        if k in _RATE_FIELDS and not 0.0 <= clean[k] <= 1.0:
+            raise ValueError(f"knob {k}: must be in [0, 1], got {v}")
+    heal = clean.get("heal_at", base_config.heal_at)
+    part = clean.get("partition_at", base_config.partition_at)
+    if heal >= 0 and part < 0:
+        raise ValueError("heal_at requires partition_at")
+    if part >= 0 and 0 <= heal < part:
+        raise ValueError("heal_at must not precede partition_at")
+    try:
+        seed = int(raw.get("seed", base_config.seed))
+        rank = int(raw.get("origin_rank", base_config.origin_rank))
+    except (TypeError, ValueError):
+        raise ValueError("seed / origin_rank must be integers")
+    if rank < 1:
+        raise ValueError(f"origin_rank must be >= 1, got {rank}")
+    rid = str(raw.get("id") or default_id)
+    if len(rid) > 128 or any(c in rid for c in "/\\ \n\t"):
+        raise ValueError(f"bad request id {rid!r} (<=128 chars, no "
+                         f"slashes or whitespace)")
+    return ScenarioRequest(
+        id=rid,
+        tenant=str(raw.get("tenant") or "default")[:64],
+        seed=seed,
+        origin_rank=rank,
+        knobs=clean,
+        start_ts=str(raw.get("start_ts") or time.time_ns()),
+        submitted_ts=time.time(),
+    )

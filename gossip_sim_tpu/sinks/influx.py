@@ -93,16 +93,27 @@ class DatapointQueue:
         normalized form two runs of the same simulation must agree on —
         the lane-sweep parity tests and tools/lane_smoke.py both diff it,
         so the Influx bit-exactness contract has one definition."""
-        lines = []
+        raw = []
         while len(self):
-            dp = self.pop_front()
-            for ln in dp.data().splitlines():
-                if (not ln or ln.startswith("sim_perf")
-                        or ln.startswith("sim_capacity")
-                        or ln.startswith("sim_node_health")):
-                    continue
-                lines.append(ln.rsplit(" ", 1)[0])
-        return lines
+            raw.extend(self.pop_front().data().splitlines())
+        return deterministic_wire_lines(raw)
+
+
+def deterministic_wire_lines(lines) -> list:
+    """Normalize raw line-protocol strings into the deterministic wire
+    payload (the same filter/strip :meth:`DatapointQueue.
+    drain_deterministic_lines` applies) — shared with the serve daemon,
+    whose per-request result carries its lines in this exact form so the
+    serve_smoke parity diff and the lane-sweep parity diff agree on one
+    definition."""
+    out = []
+    for ln in lines:
+        if (not ln or ln.startswith("sim_perf")
+                or ln.startswith("sim_capacity")
+                or ln.startswith("sim_node_health")):
+            continue
+        out.append(ln.rsplit(" ", 1)[0])
+    return out
 
 
 class Tracker:

@@ -50,6 +50,22 @@ def test_sim_state_ledger_exact(n, o, s):
     assert capacity.predict_sim_state_bytes(params, o) == live
 
 
+@pytest.mark.parametrize("n,o", [(64, 1), (150, 2)])
+def test_request_ledger_exact(n, o):
+    # the serve daemon's admission price (ISSUE 20): one request's lane
+    # slice, priced without touching the device, equals the live bytes
+    # of the state the daemon would actually splice in
+    params = EngineParams(num_nodes=n)
+    tables = make_cluster_tables(synth_stakes(n))
+    origins = jnp.arange(o, dtype=jnp.int32)
+    state = init_state(jax.random.PRNGKey(2), tables, origins, params)
+    live, _ = capacity.measure_pytree(state)
+    assert capacity.predict_request_bytes(params, o) == live
+    assert capacity.predict_request_bytes(params, origins) == live
+    with pytest.raises(ValueError):
+        capacity.predict_request_bytes(params, 0)
+
+
 @pytest.mark.parametrize("mode", ["push", "push-pull", "adaptive"])
 def test_sim_state_ledger_exact_across_modes(mode):
     # SimState geometry is mode-invariant (the pull accumulators always

@@ -1,0 +1,410 @@
+"""Gossip-as-a-service subsystem tests (serve/, ISSUE 20).
+
+Three layers, matching the daemon's decomposition:
+
+* **engine/lanes.py dynamic membership** — the execution primitive: a
+  single-lane blocked run is bit-identical to the one-shot lane path,
+  K co-resident lanes with different seeds/origins/knobs (including a
+  gate-union lane riding the impaired graph at its off endpoint) are
+  each bit-identical to their solo runs, admission via
+  ``splice_lane_state`` is a bit-exact no-op for surviving lanes, and
+  steady-state admissions never recompile (only a gate-union widening
+  does, exactly once).  These four proofs are compile-heavy (~40 s of
+  CPU jit) and marked ``slow``; tools/serve_smoke.py gates the same
+  contracts end-to-end every CI run.
+* **serve/admission.py** — the ledger-driven controller: 413 over
+  budget, fits-the-machine-not-the-moment queuing, 429 backpressure,
+  FIFO-per-tenant round-robin fairness, byte-reservation release.
+* **serve/request.py + events v2** — request validation (unknown knobs
+  are errors, rates range-checked, ids sanitized) and the serve event
+  lifecycle: serve events carry the v2 schema tag while non-serve runs
+  still emit pure v1 logs that v1 consumers keep validating.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import jax.numpy as jnp
+
+from gossip_sim_tpu.config import Config
+from gossip_sim_tpu.engine import (EngineParams, broadcast_state,
+                                   clear_dyn_lane_cache,
+                                   dyn_lane_cache_size, init_state,
+                                   lane_state, make_cluster_tables,
+                                   merge_lane_statics, run_rounds,
+                                   run_rounds_lanes, run_rounds_lanes_dyn,
+                                   splice_lane_state, stack_knobs,
+                                   stack_origins)
+from gossip_sim_tpu.obs.telemetry import (EVENT_SCHEMA, EVENT_SCHEMA_V2,
+                                          get_hub, validate_event)
+from gossip_sim_tpu.serve import (AdmissionController, RejectedRequest,
+                                  ScenarioRequest, block_rounds,
+                                  parse_request)
+
+N = 96
+TOTAL = 12
+BLOCK = 4
+
+
+def _cluster(n=N, seed=11):
+    rng = np.random.default_rng(seed)
+    stakes = rng.choice(np.arange(1, 50 * n), size=n,
+                        replace=False).astype(np.int64) * 10**9
+    return make_cluster_tables(stakes)
+
+
+def _solo(params, tables, org, key, rounds=TOTAL):
+    state = init_state(jax.random.PRNGKey(key), tables, org, params)
+    state, rows = run_rounds(params, tables, org, state, rounds)
+    return (jax.tree_util.tree_map(np.asarray, state),
+            jax.tree_util.tree_map(np.asarray, rows))
+
+
+def _np(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def _assert_tree_equal(a, b, what):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=what)
+
+
+# --------------------------------------------------------------------------
+# scheduler arithmetic
+# --------------------------------------------------------------------------
+
+def test_block_rounds_largest_divisor():
+    assert block_rounds(60, 5) == 5
+    assert block_rounds(60, 7) == 6      # largest divisor <= 7
+    assert block_rounds(7, 5) == 1       # prime total: fall back to 1
+    assert block_rounds(12, 100) == 12   # requested past total: one block
+    assert block_rounds(12, 0) == 1
+    for total, req in [(60, 5), (60, 7), (48, 9), (100, 13)]:
+        b = block_rounds(total, req)
+        assert total % b == 0 and b <= max(1, min(req, total))
+
+
+def test_stack_origins_validates_widths():
+    o = stack_origins([jnp.asarray([1], jnp.int32),
+                       jnp.asarray([4], jnp.int32)])
+    assert o.shape == (2, 1) and o.dtype == jnp.int32
+    with pytest.raises(ValueError):
+        stack_origins([jnp.asarray([1], jnp.int32),
+                       jnp.asarray([2, 3], jnp.int32)])
+    with pytest.raises(ValueError):
+        stack_origins([])
+
+
+# --------------------------------------------------------------------------
+# dynamic lane membership: the daemon's execution primitive
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_dyn_blocked_single_lane_bit_equals_one_shot():
+    # TOTAL rounds in BLOCK-round pieces through run_rounds_lanes_dyn
+    # must equal the one-shot static lane path bit for bit: the traced
+    # per-lane start_its reproduces arange(num_iters) + start_it exactly
+    params = EngineParams(num_nodes=N)
+    tables = _cluster()
+    org = jnp.asarray([2], jnp.int32)
+    static = params.static_part()
+    knobs = stack_knobs([params.knob_values()])
+
+    base = init_state(jax.random.PRNGKey(7), tables, org, params)
+    ref_states, ref_rows = run_rounds_lanes(
+        static, tables, org, broadcast_state(base, 1), knobs, TOTAL)
+    ref_states, ref_rows = _np(ref_states), _np(ref_rows)
+
+    states = broadcast_state(
+        init_state(jax.random.PRNGKey(7), tables, org, params), 1)
+    ostack = stack_origins([org])
+    chunks = []
+    for off in range(0, TOTAL, BLOCK):
+        states, rows = run_rounds_lanes_dyn(
+            static, tables, ostack, states, knobs, BLOCK,
+            jnp.asarray([off], jnp.int32))
+        chunks.append(_np(rows))
+    # rows are time-major [num_iters, K, ...]: stitch the blocks in time
+    got_rows = {k: np.concatenate([c[k] for c in chunks], axis=0)
+                for k in chunks[0]}
+    assert set(got_rows) == set(ref_rows)
+    for k in ref_rows:
+        np.testing.assert_array_equal(got_rows[k], np.asarray(ref_rows[k]),
+                                      err_msg=f"rows[{k}]")
+    _assert_tree_equal(_np(states), ref_states, "final state")
+
+
+@pytest.mark.slow
+def test_dyn_mixed_lanes_bit_equal_solo_runs():
+    # two co-resident scenarios: different seeds, origins, traced knob
+    # values AND impairment gates — the union static runs the loss-free
+    # lane through the loss-gated graph at rate 0 bit-identically
+    tables = _cluster()
+    p0 = EngineParams(num_nodes=N, packet_loss_rate=0.1,
+                      probability_of_rotation=0.2)
+    p1 = EngineParams(num_nodes=N)           # no loss gate of its own
+    org0 = jnp.asarray([1], jnp.int32)
+    org1 = jnp.asarray([5], jnp.int32)
+    union = merge_lane_statics([p0.static_part(), p1.static_part()])
+    assert union.has_loss and not p1.static_part().has_loss
+    knobs = stack_knobs([p0.knob_values(), p1.knob_values()])
+    ostack = stack_origins([org0, org1])
+
+    s0 = init_state(jax.random.PRNGKey(3), tables, org0, p0)
+    s1 = init_state(jax.random.PRNGKey(9), tables, org1, p1)
+    states = splice_lane_state(broadcast_state(s0, 2), 1, s1)
+    chunks = []
+    for off in range(0, TOTAL, BLOCK):
+        states, rows = run_rounds_lanes_dyn(
+            union, tables, ostack, states, knobs, BLOCK,
+            jnp.asarray([off, off], jnp.int32))
+        chunks.append(_np(rows))
+    got = {k: np.concatenate([c[k] for c in chunks], axis=0)
+           for k in chunks[0]}
+
+    ref0_state, ref0_rows = _solo(p0, tables, org0, key=3)
+    ref1_state, ref1_rows = _solo(p1, tables, org1, key=9)
+    for lane, ref_rows in ((0, ref0_rows), (1, ref1_rows)):
+        for k in ref_rows:
+            np.testing.assert_array_equal(
+                got[k][:, lane], np.asarray(ref_rows[k]),
+                err_msg=f"lane {lane} rows[{k}]")
+    _assert_tree_equal(lane_state(_np(states), 0), ref0_state,
+                       "lane 0 state")
+    _assert_tree_equal(lane_state(_np(states), 1), ref1_state,
+                       "lane 1 state")
+
+
+@pytest.mark.slow
+def test_dyn_admission_splice_is_noop_for_survivor():
+    # lane 0 retires mid-stream and a NEW request is spliced in (fresh
+    # state, new origin, new start offset 0) while lane 1 keeps running:
+    # lane 1's remaining rows and final state must not move by one bit
+    tables = _cluster()
+    params = EngineParams(num_nodes=N)
+    static = params.static_part()
+    org_a = jnp.asarray([1], jnp.int32)   # short request in lane 0
+    org_b = jnp.asarray([4], jnp.int32)   # survivor in lane 1
+    org_c = jnp.asarray([7], jnp.int32)   # admitted into lane 0 later
+    knobs = stack_knobs([params.knob_values(), params.knob_values()])
+
+    sa = init_state(jax.random.PRNGKey(1), tables, org_a, params)
+    sb = init_state(jax.random.PRNGKey(2), tables, org_b, params)
+    states = splice_lane_state(broadcast_state(sa, 2), 1, sb)
+    ostack = stack_origins([org_a, org_b])
+    survivor_rows = []
+    # block 1: both run their first BLOCK rounds
+    states, rows = run_rounds_lanes_dyn(
+        static, tables, ostack, states, knobs, BLOCK,
+        jnp.asarray([0, 0], jnp.int32))
+    survivor_rows.append(_np(rows))
+    # lane 0 "retires": admit request c at offset 0, survivor continues
+    sc = init_state(jax.random.PRNGKey(5), tables, org_c, params)
+    states = splice_lane_state(states, 0, sc)
+    ostack = stack_origins([org_c, org_b])
+    for off in range(BLOCK, TOTAL, BLOCK):
+        states, rows = run_rounds_lanes_dyn(
+            static, tables, ostack, states, knobs, BLOCK,
+            jnp.asarray([off - BLOCK, off], jnp.int32))
+        survivor_rows.append(_np(rows))
+    got_b = {k: np.concatenate([c[k][:, 1] for c in survivor_rows], axis=0)
+             for k in survivor_rows[0]}
+
+    ref_state, ref_rows = _solo(params, tables, org_b, key=2)
+    for k in ref_rows:
+        np.testing.assert_array_equal(got_b[k], np.asarray(ref_rows[k]),
+                                      err_msg=f"survivor rows[{k}]")
+    _assert_tree_equal(lane_state(_np(states), 1), ref_state,
+                       "survivor state")
+
+
+@pytest.mark.slow
+def test_dyn_steady_state_zero_recompiles_gate_union_once():
+    # the serve compile contract: admissions with new knob VALUES, new
+    # origins, and new start offsets re-enter the one warm executable;
+    # only widening the impairment gate union compiles — exactly once
+    tables = _cluster()
+    params = EngineParams(num_nodes=N)
+    static = params.static_part()
+    org = jnp.asarray([1], jnp.int32)
+    ostack = stack_origins([org, org])
+    base = init_state(jax.random.PRNGKey(0), tables, org, params)
+    states = broadcast_state(base, 2)
+    knobs = stack_knobs([params.knob_values(), params.knob_values()])
+
+    clear_dyn_lane_cache()
+    states, _ = run_rounds_lanes_dyn(static, tables, ostack, states,
+                                     knobs, BLOCK,
+                                     jnp.asarray([0, 0], jnp.int32))
+    assert dyn_lane_cache_size() == 1
+    # steady state: different knob values / origins / offsets — no compile
+    p2 = params._replace(probability_of_rotation=0.31)
+    knobs2 = stack_knobs([p2.knob_values(), params.knob_values()])
+    ostack2 = stack_origins([jnp.asarray([8], jnp.int32), org])
+    states, _ = run_rounds_lanes_dyn(static, tables, ostack2, states,
+                                     knobs2, BLOCK,
+                                     jnp.asarray([4, 8], jnp.int32))
+    assert dyn_lane_cache_size() == 1
+    # gate-union widening (first lossy admission): one new executable
+    lossy = params._replace(packet_loss_rate=0.05)
+    union = merge_lane_statics([lossy.static_part(), static])
+    knobs3 = stack_knobs([lossy.knob_values(), params.knob_values()])
+    states, _ = run_rounds_lanes_dyn(union, tables, ostack, states,
+                                     knobs3, BLOCK,
+                                     jnp.asarray([0, 0], jnp.int32))
+    assert dyn_lane_cache_size() == 2
+    # further lossy admissions ride the widened executable
+    lossy2 = params._replace(packet_loss_rate=0.08)
+    knobs4 = stack_knobs([lossy2.knob_values(), lossy.knob_values()])
+    states, _ = run_rounds_lanes_dyn(union, tables, ostack, states,
+                                     knobs4, BLOCK,
+                                     jnp.asarray([4, 4], jnp.int32))
+    assert dyn_lane_cache_size() == 2
+
+
+# --------------------------------------------------------------------------
+# admission control (serve/admission.py)
+# --------------------------------------------------------------------------
+
+def _req(rid, tenant="t", bytes_=100):
+    r = ScenarioRequest(id=rid, tenant=tenant)
+    r.predicted_bytes = bytes_
+    return r
+
+
+def test_admission_413_over_budget_carries_ledger_detail():
+    adm = AdmissionController(budget_bytes=1000)
+    with pytest.raises(RejectedRequest) as ei:
+        adm.submit(_req("big", bytes_=2000))
+    e = ei.value
+    assert e.code == 413
+    assert e.payload()["predicted_bytes"] == 2000
+    assert e.payload()["budget_bytes"] == 1000
+    assert adm.counters == {"received": 1, "admitted": 0, "rejected": 1,
+                            "completed": 0}
+    assert adm.tenants_rejected == {"t": 1}
+
+
+def test_admission_fits_machine_not_moment_waits_for_completion():
+    adm = AdmissionController(budget_bytes=1000)
+    r1, r2 = _req("r1", bytes_=600), _req("r2", bytes_=600)
+    adm.submit(r1)
+    adm.submit(r2)                        # fits the machine: queued, not 413
+    assert adm.next_admission() is r1
+    assert adm.bytes_in_use() == 600
+    assert adm.next_admission() is None   # not the moment
+    adm.complete(r1)
+    assert adm.bytes_in_use() == 0
+    assert adm.next_admission() is r2
+
+
+def test_admission_429_queue_full():
+    adm = AdmissionController(max_queue=1)
+    adm.submit(_req("q1"))
+    with pytest.raises(RejectedRequest) as ei:
+        adm.submit(_req("q2"))
+    assert ei.value.code == 429
+
+
+def test_admission_round_robin_is_fair_across_tenants():
+    # alice sprays 3 requests before bob's 1 arrives; bob still runs 2nd
+    adm = AdmissionController()
+    a1, a2, a3 = (_req(f"a{i}", tenant="alice") for i in (1, 2, 3))
+    b1 = _req("b1", tenant="bob")
+    for r in (a1, a2, a3, b1):
+        adm.submit(r)
+    order = [adm.next_admission().id for _ in range(4)]
+    assert order == ["a1", "b1", "a2", "a3"]
+    assert adm.tenants_admitted == {"alice": 3, "bob": 1}
+
+
+def test_admission_unmetered_budget_reports_unlimited():
+    adm = AdmissionController(budget_bytes=0)
+    assert adm.available_bytes() == -1
+    adm.submit(_req("r", bytes_=10**15))  # no budget: any size queues
+
+
+# --------------------------------------------------------------------------
+# request schema (serve/request.py)
+# --------------------------------------------------------------------------
+
+def _base_config():
+    return Config(num_synthetic_nodes=150, gossip_iterations=20,
+                  warm_up_rounds=4, seed=3, serve=True)
+
+
+def test_parse_request_rejects_unknown_knob_and_bad_rates():
+    base = _base_config()
+    with pytest.raises(ValueError, match="unknown knob"):
+        parse_request({"id": "r", "knobs": {"bogus": 1}}, base,
+                      default_id="d")
+    with pytest.raises(ValueError, match=r"\[0, 1\]"):
+        parse_request({"id": "r", "knobs": {"packet_loss_rate": 1.5}},
+                      base, default_id="d")
+    with pytest.raises(ValueError, match="not JSON"):
+        parse_request(b"{nope", base, default_id="d")
+    with pytest.raises(ValueError, match="JSON object"):
+        parse_request([1, 2], base, default_id="d")
+    with pytest.raises(ValueError, match="origin_rank"):
+        parse_request({"origin_rank": 0}, base, default_id="d")
+    with pytest.raises(ValueError, match="bad request id"):
+        parse_request({"id": "has space"}, base, default_id="d")
+
+
+def test_parse_request_defaults_and_spec_roundtrip():
+    base = _base_config()
+    req = parse_request(json.dumps({"tenant": "alice", "seed": 259,
+                                    "knobs": {"packet_loss_rate": 0.05}}),
+                        base, default_id="gen-1")
+    assert req.id == "gen-1" and req.tenant == "alice"
+    # spec() -> parse_request round-trips bit-exactly (the intake journal
+    # re-admission contract)
+    req2 = parse_request(req.spec(), base, default_id="other")
+    assert req2.spec() == req.spec()
+
+
+def test_request_config_is_one_solo_lane_point():
+    base = _base_config()
+    req = parse_request({"id": "r", "seed": 259, "origin_rank": 2,
+                         "knobs": {"probability_of_rotation": 0.2}},
+                        base, default_id="d")
+    rc = req.request_config(base)
+    assert rc.seed == 259 and rc.origin_rank == 2
+    assert rc.num_simulations == 1 and rc.sweep_lanes == 1
+    assert rc.checkpoint_path == "" and rc.resume_path == ""
+    assert rc.probability_of_rotation == pytest.approx(0.2)
+    # untouched geometry: the request cannot change the compile key
+    assert rc.num_synthetic_nodes == base.num_synthetic_nodes
+    assert rc.gossip_iterations == base.gossip_iterations
+
+
+# --------------------------------------------------------------------------
+# events v2 (serve lifecycle) — v1 logs stay pure and keep validating
+# --------------------------------------------------------------------------
+
+def test_serve_events_carry_v2_schema_and_validate():
+    rec = get_hub().emit("request_admitted", id="r1", tenant="alice",
+                         lane=0)
+    assert rec["schema"] == EVENT_SCHEMA_V2
+    assert validate_event(rec) == []
+    rec = get_hub().emit("journal_commit", unit=0)
+    assert rec["schema"] == EVENT_SCHEMA      # non-serve events stay v1
+    assert validate_event(rec) == []
+
+
+def test_v1_schema_is_closed_to_serve_events():
+    # a serve event mis-tagged v1 is a bug, not forward compatibility
+    bad = {"schema": EVENT_SCHEMA, "seq": 1, "ts": 0.0,
+           "ev": "request_admitted", "run": ""}
+    assert any("unknown event type" in p for p in validate_event(bad))
+    ok = dict(bad, schema=EVENT_SCHEMA_V2)
+    assert validate_event(ok) == []

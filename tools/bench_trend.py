@@ -50,6 +50,7 @@ DEFAULT_METRICS = [
     ("sparse_steps_per_sec", True),             # BENCH_r10+ (ISSUE 19)
     ("sparse.mem_bytes_per_node", False),
     ("sparse.xla_temp_bytes", False),
+    ("serve_requests_per_sec", True),           # BENCH_r11+ (ISSUE 20)
 ]
 
 #: Reported but never flagged: derived ratios of two metrics that are

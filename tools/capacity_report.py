@@ -144,6 +144,15 @@ def main() -> int:
                                  "beyond_engine_cap":
                                      fit_n > ENGINE_NODE_CAP}
 
+    # gossip-as-a-service admission price (serve/, ISSUE 20): the SAME
+    # closed form the --serve daemon's ledger admission charges per
+    # request (obs/capacity.predict_request_bytes)
+    per_req = capacity.predict_request_bytes(params, 1)
+    answers["serve_admission"] = {"request_bytes": per_req}
+    if args.fit_budget:
+        answers["serve_admission"]["requests_per_budget"] = \
+            answers["fit_budget"]["budget_bytes"] // max(per_req, 1)
+
     if args.json:
         print(json.dumps(answers, indent=2))
         return 0
@@ -213,6 +222,12 @@ def main() -> int:
                                for e in top_dense)
                      if top_dense else "none flagged — linear terms "
                      "dominate; raise the batch or shard nodes"))
+
+    sa = answers["serve_admission"]
+    print(f"  serve admission price (--serve ledger): "
+          f"{human(sa['request_bytes'])} per request"
+          + (f"; {sa['requests_per_budget']:,} request(s) fit the budget"
+             if "requests_per_budget" in sa else ""))
     return 0
 
 

@@ -47,10 +47,18 @@ The gates, in dependency-light-first order:
                 capacity-ledger closed forms == live nbytes at two
                 (N, C) points, 16GB all-origins fit strictly beyond
                 the dense ceiling
+  serve_smoke   gossip-as-a-service daemon (ISSUE 20): mid-flight
+                continuous-batching admissions bit-identical (parity
+                snapshot + deterministic wire lines) to solo
+                run_lane_sweep, ledger 413/429/400 refusals with zero
+                device allocations, SIGTERM drain -> exit 75 -> --resume
+                completes every intake-journaled request bit-exactly
+                with zero persistent-cache misses, zero steady-state
+                recompiles on the warm dyn-lane executable
 
 Usage: python tools/ci_gates.py [--only NAME[,NAME...]] [--list] [--json]
 
-``--only`` runs a subset (fourteen serial gates take a while — pick the
+``--only`` runs a subset (fifteen serial gates take a while — pick the
 ones your change touches); ``--list`` prints the registry and exits.
 The summary table carries each gate's wall time; ``--json`` replaces it
 with one machine-readable JSON object (the last line of output) carrying
@@ -69,7 +77,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 GATES = ["chaos_smoke", "obs_smoke", "trace_smoke", "sweep_smoke",
          "pull_smoke", "lane_smoke", "resume_smoke", "traffic_smoke",
          "adaptive_smoke", "capacity_smoke", "health_smoke",
-         "telemetry_smoke", "bench_trend", "sparse_smoke"]
+         "telemetry_smoke", "bench_trend", "sparse_smoke", "serve_smoke"]
 
 # per-gate extra argv: most gates run bare; bench_trend only gates CI
 # when asked to fail on regressions, and only on the newest committed

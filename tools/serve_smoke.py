@@ -1,0 +1,531 @@
+"""Gossip-as-a-service smoke test: the CI gate for the serve/ daemon
+(ISSUE 20).
+
+Four acceptance gates over the continuous-batching scenario daemon, each
+arm its own subprocess (the daemon owns global state — pubkey counter,
+telemetry hub, jit caches — so cross-arm isolation must be real):
+
+  a. **Mid-flight parity**: a warm 2-lane daemon admits five requests —
+     one HTTP request deliberately held until the first is provably
+     mid-flight (rounds_done > 0), plus one through the spool intake —
+     and every request's parity snapshot AND deterministic Influx wire
+     lines must be byte-identical to the same config run SOLO through
+     run_lane_sweep.  The event log must validate (v2) and must show at
+     least one admission landing between another request's admission and
+     completion (continuous batching actually happened, not a lucky
+     serial schedule).
+  b. **Ledger admission**: an over-budget request is 413-rejected with
+     the ledger-predicted and available byte counts in the refusal, and
+     the daemon provably makes ZERO device allocations for it (the lazy
+     device plane is never initialized).  Queue-full 429, unknown-knob
+     400, and duplicate-id 400 ride along.
+  c. **Crash recovery**: GOSSIP_RESILIENCE_KILL_AFTER_UNITS=1 SIGTERMs
+     the daemon after its first committed request; it must drain
+     co-resident lanes (committing them too), admit nothing new, and
+     exit 75.  A restart of the same argv + --resume must complete every
+     intake-journaled request with snapshots + wire lines bit-identical
+     to the solo references, with ZERO persistent-compilation-cache
+     misses (the killed arm's XLA cache serves every restart compile).
+  d. **Zero steady-state recompiles**: engine/compiles scraped from
+     /metrics at the first completion equals the end-of-run counter —
+     admissions into the warm executable after warmup never recompile
+     (knob VALUES are traced; only a gate-union flip may compile, once,
+     and arm a's first admission documents that flag on its event).
+
+Usage: python tools/serve_smoke.py [--nodes 400] [--iterations 60]
+       [--warm-up 10] [--block 5] [--seed 5]
+
+Exit code 0 = the gossip-as-a-service contract holds; 1 = it broke.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESUMABLE = 75
+
+# the five scenario requests every arm shares: two tenants interleaved,
+# distinct seeds/origins, loss knobs on 1+3 (3 proves a traced VALUE
+# change recompiles nothing), a5 submitted through the spool intake.
+# Seeds are all == 5 (mod 256) on purpose: the daemon's synthetic
+# cluster is generated ONCE from the base config's seed % 256
+# (cli.load_cluster_accounts), and a request's seed drives only the
+# simulation PRNG + impairment hashes — so the solo reference arm
+# (which re-derives the cluster from the request config) reproduces
+# the daemon's exact stake distribution only for seeds in the same
+# residue class as --seed 5.
+SPECS = [
+    {"id": "a1", "tenant": "alice", "seed": 261, "origin_rank": 2,
+     "start_ts": "0", "knobs": {"packet_loss_rate": 0.05}},
+    {"id": "a2", "tenant": "bob", "seed": 517, "origin_rank": 1,
+     "start_ts": "0", "knobs": {}},
+    {"id": "a3", "tenant": "alice", "seed": 773, "origin_rank": 3,
+     "start_ts": "0", "knobs": {"packet_loss_rate": 0.08}},
+    {"id": "a4", "tenant": "bob", "seed": 1029, "origin_rank": 1,
+     "start_ts": "0", "knobs": {}},
+]
+SPOOL_SPEC = {"id": "a5", "tenant": "carol", "seed": 1285,
+              "origin_rank": 2, "start_ts": "0", "knobs": {}}
+
+
+def base_argv(args):
+    return ["--serve", "--num-synthetic-nodes", str(args.nodes),
+            "--iterations", str(args.iterations),
+            "--warm-up-rounds", str(args.warm_up),
+            "--seed", str(args.seed), "--serve-lanes", "2",
+            "--serve-block-rounds", str(args.block)]
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+# ---------------------------------------------------------------------------
+# worker: one daemon run (cli.main on the MAIN thread so signal handlers
+# install; the HTTP client drives intake from a background thread)
+# ---------------------------------------------------------------------------
+def worker_serve(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from gossip_sim_tpu.cli import main as cli_main
+    from gossip_sim_tpu.obs import get_registry
+    from gossip_sim_tpu.obs.exporter import parse_prometheus_text
+    from gossip_sim_tpu.obs.telemetry import load_event_log
+    from gossip_sim_tpu.engine.cache import persistent_cache_counters
+    from gossip_sim_tpu.resilience import journal_path
+    from gossip_sim_tpu.sinks.influx import deterministic_wire_lines
+
+    specs = json.loads(args.specs) if args.specs else []
+    argv = base_argv(args) + ["--telemetry-port", "0",
+                              "--event-log", args.event_log,
+                              "--serve-idle-timeout-s", "120"]
+    if args.max_requests:
+        argv += ["--serve-max-requests", str(args.max_requests)]
+    if args.checkpoint:
+        argv += ["--checkpoint-path", args.checkpoint]
+    if args.resume:
+        argv += ["--resume", args.resume]
+    if args.cache_dir:
+        argv += ["--compilation-cache-dir", args.cache_dir]
+    if args.spool:
+        argv += ["--serve-spool-dir", args.spool]
+
+    out = {"submit": {}, "results": {}, "compiles_at_first_done": -1.0}
+    done = threading.Event()
+
+    def client():
+        port = None
+        deadline = time.time() + 120
+        while time.time() < deadline and port is None and not done.is_set():
+            if os.path.exists(args.event_log):
+                for rec in load_event_log(args.event_log):
+                    if rec.get("ev") == "telemetry_listen":
+                        port = rec.get("port")
+            if port is None:
+                time.sleep(0.05)
+        out["port"] = port
+        if port is None or done.is_set():
+            return
+        base = f"http://127.0.0.1:{port}"
+
+        def submit(spec):
+            body = json.dumps(spec).encode()
+            dl = time.time() + 90
+            while True:  # routes mount just after the port binds: retry
+                req = urllib.request.Request(base + "/submit", data=body,
+                                             method="POST")
+                try:
+                    return 200, json.loads(_get_req(req))
+                except urllib.error.HTTPError as e:
+                    if e.code == 404 and time.time() < dl:
+                        time.sleep(0.1)
+                        continue
+                    return e.code, json.loads(e.read() or b"{}")
+                except OSError:
+                    if time.time() < dl:
+                        time.sleep(0.1)
+                        continue
+                    return -1, {}
+
+        def _get_req(req):
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.read()
+
+        def result(rid):
+            # urllib only raises for >=400: a 202 "still running" reply
+            # comes back as a success, so read the REAL status code
+            try:
+                with urllib.request.urlopen(f"{base}/result/{rid}",
+                                            timeout=10) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read() or b"{}")
+
+        for i, spec in enumerate(specs):
+            if i == 1 and args.stagger:
+                # hold the second submission until the first request is
+                # provably mid-flight: >=1 block done, not yet finished
+                dl = time.time() + 240
+                while time.time() < dl and not done.is_set():
+                    code, p = result(specs[0]["id"])
+                    if code == 200 or (code == 202
+                                       and p.get("rounds_done", 0) > 0):
+                        break
+                    time.sleep(0.01)
+            code, body = submit(spec)
+            out["submit"][spec["id"]] = {"code": code, "body": body}
+        if args.spool and args.spool_spec:
+            sp = json.loads(args.spool_spec)
+            tmp = os.path.join(args.spool, sp["id"] + ".json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(sp, f)
+            os.replace(tmp, os.path.join(args.spool, sp["id"] + ".json"))
+            specs.append(sp)
+
+        pending = {s["id"] for s in specs}
+        dl = time.time() + 420
+        while pending and time.time() < dl and not done.is_set():
+            for rid in sorted(pending):
+                try:
+                    code, p = result(rid)
+                except OSError:
+                    return
+                if code == 200:
+                    out["results"][rid] = p
+                    pending.discard(rid)
+            if out["results"] and out["compiles_at_first_done"] < 0:
+                try:  # gate d: the counter the moment work first retired
+                    m = parse_prometheus_text(
+                        _get(base + "/metrics").decode())
+                    out["compiles_at_first_done"] = m.get(
+                        "gossip_sim_counter_total", {}).get(
+                        '{counter="engine/compiles"}', -1.0)
+                except (OSError, ValueError):
+                    pass
+            time.sleep(0.05)
+
+    th = threading.Thread(target=client, daemon=True)
+    th.start()
+    rc = cli_main(argv)
+    done.set()
+    th.join(timeout=10)
+    out["rc"] = rc
+    out["compiles_end"] = float(get_registry().counter("engine/compiles"))
+    out["cache"] = persistent_cache_counters()
+    if args.checkpoint:  # the authoritative per-request outputs
+        jp = journal_path(args.checkpoint)
+        out["journal"] = {}
+        if os.path.exists(jp):
+            with open(jp) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+            for ln in lines[1:]:
+                rec = json.loads(ln)
+                payload = rec.get("payload", rec)
+                spec = payload.get("request") or {}
+                sims = payload.get("sims") or []
+                out["journal"][str(spec.get("id"))] = {
+                    "unit": rec.get("unit"),
+                    "snapshot": sims[0][1].get("snapshot") if sims else None,
+                    # journaled lines are whole point bodies (multi-line,
+                    # timestamped, replayed verbatim): split to wire lines
+                    # before normalizing
+                    "dlines": deterministic_wire_lines(
+                        [ln for body in payload.get("lines", [])
+                         for ln in body.splitlines()]),
+                }
+    with open(args.out, "w") as f:
+        json.dump(out, f)
+    return rc
+
+
+# ---------------------------------------------------------------------------
+# worker: solo references — each spec run alone through run_lane_sweep
+# ---------------------------------------------------------------------------
+def worker_solo(args) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from gossip_sim_tpu import resilience
+    from gossip_sim_tpu.cli import build_parser, config_from_args, \
+        run_lane_sweep
+    from gossip_sim_tpu.identity import reset_unique_pubkeys
+    from gossip_sim_tpu.obs import get_registry
+    from gossip_sim_tpu.resilience import snapshot_to_jsonable
+    from gossip_sim_tpu.serve import parse_request
+    from gossip_sim_tpu.sinks import DatapointQueue
+    from gossip_sim_tpu.stats.gossip_stats import GossipStatsCollection
+
+    # the daemon's base config, bit for bit: same argv, same parser
+    base = config_from_args(build_parser().parse_args(base_argv(args)))
+    out = {}
+    for spec in json.loads(args.specs):
+        req = parse_request(spec, base, default_id="solo")
+        rc = req.request_config(base)
+        reset_unique_pubkeys()
+        get_registry().reset()
+        resilience.reset_shutdown()
+        coll = GossipStatsCollection()
+        coll.set_number_of_simulations(1)
+        dpq = DatapointQueue()
+        run_lane_sweep(rc, "", [rc.origin_rank], coll, dpq,
+                       spec.get("start_ts", "0"))
+        out[spec["id"]] = {
+            "snapshot": snapshot_to_jsonable(
+                coll.collection[0].parity_snapshot()),
+            "dlines": dpq.drain_deterministic_lines(),
+        }
+    with open(args.out, "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="gossip-as-a-service daemon smoke (CPU)")
+    ap.add_argument("--nodes", type=int, default=400)
+    ap.add_argument("--iterations", type=int, default=60)
+    ap.add_argument("--warm-up", type=int, default=10)
+    ap.add_argument("--block", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=5)
+    # worker modes (internal)
+    ap.add_argument("--worker-serve", action="store_true")
+    ap.add_argument("--worker-solo", action="store_true")
+    ap.add_argument("--specs", default="")
+    ap.add_argument("--spool-spec", default="")
+    ap.add_argument("--spool", default="")
+    ap.add_argument("--stagger", action="store_true")
+    ap.add_argument("--max-requests", type=int, default=0)
+    ap.add_argument("--checkpoint", default="")
+    ap.add_argument("--resume", default="")
+    ap.add_argument("--cache-dir", default="")
+    ap.add_argument("--event-log", default="")
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    if args.worker_serve:
+        return worker_serve(args)
+    if args.worker_solo:
+        return worker_solo(args)
+
+    t0 = time.time()
+    tmp = tempfile.mkdtemp(prefix="serve-smoke-")
+    failures = []
+
+    def check(ok, msg):
+        print(f"  [{'ok' if ok else 'FAIL'}] {msg}", flush=True)
+        if not ok:
+            failures.append(msg)
+
+    def run_worker(name, mode, extra, env_extra=None):
+        out = os.path.join(tmp, f"{name}.json")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        if env_extra:
+            env.update(env_extra)
+        cmd = [sys.executable, os.path.abspath(__file__), mode,
+               "--nodes", str(args.nodes),
+               "--iterations", str(args.iterations),
+               "--warm-up", str(args.warm_up), "--block", str(args.block),
+               "--seed", str(args.seed), "--out", out] + extra
+        rc = subprocess.run(cmd, env=env, timeout=560).returncode
+        result = None
+        if os.path.exists(out):
+            with open(out) as f:
+                result = json.load(f)
+        return rc, result
+
+    print(f"serve smoke: n={args.nodes} iters={args.iterations} "
+          f"(warm {args.warm_up}) lanes=2 block={args.block}")
+
+    # ---- gate b first: pure admission logic, no daemon loop needed ------
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from gossip_sim_tpu.config import Config
+    from gossip_sim_tpu.serve import ServeDaemon
+    cfg_b = Config(num_synthetic_nodes=150, gossip_iterations=20,
+                   warm_up_rounds=4, seed=3, serve=True, serve_lanes=2,
+                   serve_block_rounds=5, serve_memory_budget="8KiB")
+    d = ServeDaemon(cfg_b, "", None, "0", None)
+    code, payload = d.submit_raw(json.dumps(
+        {"id": "big", "tenant": "alice", "seed": 1}))
+    check(code == 413, f"over-budget request refused with 413 ({code})")
+    check(payload.get("predicted_bytes", 0) > 8192
+          and payload.get("available_bytes") == 8192
+          and payload.get("budget_bytes") == 8192,
+          f"413 carries the ledger-predicted + available byte counts "
+          f"({payload.get('predicted_bytes')} predicted vs 8192 budget)")
+    check(not d._device_ready and d.tables is None and d.states is None,
+          "rejection priced host-side: zero device allocations "
+          "(device plane never initialized)")
+    check(d.admission.counters == {"received": 1, "admitted": 0,
+                                   "rejected": 1, "completed": 0},
+          f"admission counters attribute the refusal "
+          f"({d.admission.counters})")
+    cfg_q = Config(num_synthetic_nodes=150, gossip_iterations=20,
+                   warm_up_rounds=4, seed=3, serve=True, serve_lanes=2,
+                   serve_block_rounds=5, serve_max_queue=1)
+    d2 = ServeDaemon(cfg_q, "", None, "0", None)
+    c1, _ = d2.submit_raw(json.dumps({"id": "q1", "seed": 1}))
+    c2, p2 = d2.submit_raw(json.dumps({"id": "q2", "seed": 2}))
+    check(c1 == 200 and c2 == 429,
+          f"queue-full request refused with 429 ({c1}, {c2}: "
+          f"{p2.get('reason', p2)})")
+    c3, _ = d2.submit_raw(json.dumps({"id": "q1", "seed": 3}))
+    c4, _ = d2.submit_raw(json.dumps({"id": "q3", "knobs": {"bogus": 1}}))
+    check(c3 == 400 and c4 == 400,
+          f"duplicate id + unknown knob refused with 400 ({c3}, {c4})")
+    check(not d2._device_ready, "intake alone touches no device state")
+
+    # ---- solo references (gates a + c compare against these) ------------
+    all_specs = SPECS + [SPOOL_SPEC]
+    rc_solo, solo = run_worker(
+        "solo", "--worker-solo", ["--specs", json.dumps(all_specs)])
+    check(rc_solo == 0 and solo is not None
+          and set(solo or {}) == {s["id"] for s in all_specs},
+          f"solo reference arm completed ({sorted(solo or {})})")
+
+    # ---- arm A: warm daemon, staggered + spool intake (gates a, d) ------
+    evt_a = os.path.join(tmp, "serve.events")
+    spool = os.path.join(tmp, "spool")
+    cache = os.path.join(tmp, "xla-cache")  # shared: arm A compiles the
+    os.makedirs(spool, exist_ok=True)       # dyn kernel once, C/D reuse it
+    rc_a, arm_a = run_worker(
+        "daemon", "--worker-serve",
+        ["--specs", json.dumps(SPECS), "--stagger",
+         "--spool", spool, "--spool-spec", json.dumps(SPOOL_SPEC),
+         "--max-requests", str(len(SPECS) + 1),
+         "--checkpoint", os.path.join(tmp, "serve.npz"),
+         "--cache-dir", cache, "--event-log", evt_a])
+    check(rc_a == 0 and arm_a is not None,
+          f"daemon arm served {len(SPECS) + 1} requests and exited 0 "
+          f"(rc={rc_a})")
+    arm_a = arm_a or {}
+    sub = arm_a.get("submit", {})
+    check(all(sub.get(s["id"], {}).get("code") == 200 for s in SPECS),
+          f"every HTTP submission accepted "
+          f"({ {k: v.get('code') for k, v in sub.items()} })")
+    jr = arm_a.get("journal", {})
+    for spec in all_specs:
+        rid, ref = spec["id"], (solo or {}).get(spec["id"], {})
+        got = jr.get(rid, {})
+        check(bool(got) and got.get("snapshot") == ref.get("snapshot"),
+              f"{rid}: daemon parity snapshot bit-identical to solo "
+              f"run_lane_sweep")
+        check(bool(got) and got.get("dlines") == ref.get("dlines")
+              and got.get("dlines"),
+              f"{rid}: deterministic Influx wire lines bit-identical to "
+              f"solo ({len(got.get('dlines') or [])} lines)")
+    for rid, res in arm_a.get("results", {}).items():
+        check(res.get("snapshot") == jr.get(rid, {}).get("snapshot"),
+              f"{rid}: /result payload matches the journaled snapshot")
+    res_5 = os.path.join(spool, SPOOL_SPEC["id"] + ".result.json")
+    check(os.path.exists(res_5), "spool intake wrote a5.result.json")
+    if os.path.exists(res_5):
+        with open(res_5) as f:
+            sp_res = json.load(f)
+        check(sp_res.get("snapshot") == (solo or {}).get(
+            SPOOL_SPEC["id"], {}).get("snapshot"),
+              "spool result snapshot bit-identical to solo")
+
+    from gossip_sim_tpu.obs.telemetry import (load_event_log,
+                                              validate_event_log)
+    problems = validate_event_log(evt_a)
+    check(problems == [],
+          f"serve event log validates ({problems[:3] or 'clean'})")
+    recs = load_event_log(evt_a)
+    kinds = {r.get("ev") for r in recs}
+    for want in ("request_received", "request_admitted",
+                 "request_completed", "lane_evicted"):
+        check(want in kinds, f"event log carries {want}")
+    admit_at, done_at = {}, {}
+    for i, r in enumerate(recs):
+        if r.get("ev") == "request_admitted":
+            admit_at[r.get("id")] = i
+        elif r.get("ev") == "request_completed":
+            done_at[r.get("id")] = i
+    overlapped = any(
+        admit_at[r] < admit_at[s] < done_at.get(r, -1)
+        for r in admit_at for s in admit_at if r != s)
+    check(overlapped,
+          "continuous batching observed: an admission landed while "
+          "another request was mid-flight")
+    unions = [r.get("gate_union") for r in recs
+              if r.get("ev") == "request_admitted"]
+    check(any(unions),
+          f"the one impairment gate-union widening is flagged on its "
+          f"admission event ({unions})")
+
+    # ---- gate d: zero recompiles at steady state ------------------------
+    mid = arm_a.get("compiles_at_first_done", -1.0)
+    end = arm_a.get("compiles_end", -2.0)
+    check(mid > 0 and mid == end,
+          f"zero steady-state recompiles: engine/compiles at first "
+          f"completion == at exit ({mid} == {end})")
+
+    # ---- gate c: kill mid-service, restart, bit-exact completion --------
+    ck = os.path.join(tmp, "killed.npz")
+    evt_k = os.path.join(tmp, "killed.events")
+    rc_k, arm_k = run_worker(
+        "killed", "--worker-serve",
+        ["--specs", json.dumps(SPECS), "--checkpoint", ck,
+         "--cache-dir", cache, "--event-log", evt_k,
+         "--max-requests", str(len(SPECS))],
+        env_extra={"GOSSIP_RESILIENCE_KILL_AFTER_UNITS": "1"})
+    check(rc_k == RESUMABLE,
+          f"killed daemon drained and exited with the resumable code "
+          f"({rc_k} == {RESUMABLE})")
+    committed = sorted((arm_k or {}).get("journal", {}))
+    check(0 < len(committed) < len(SPECS),
+          f"kill landed mid-service: {len(committed)}/{len(SPECS)} "
+          f"requests committed ({committed})")
+    intake = []
+    intake_path = ck[:-len(".npz")] + ".journal.intake"
+    if os.path.exists(intake_path):
+        with open(intake_path) as f:
+            intake = [json.loads(ln)["id"] for ln in
+                      f.read().splitlines() if ln.strip()]
+    check(sorted(intake) == sorted(s["id"] for s in SPECS),
+          f"intake sidecar journaled every accepted request ({intake})")
+
+    evt_r = os.path.join(tmp, "restart.events")
+    rc_r, arm_r = run_worker(
+        "restart", "--worker-serve",
+        ["--specs", "[]", "--checkpoint", ck, "--resume", ck,
+         "--cache-dir", cache, "--event-log", evt_r,
+         "--max-requests", str(len(SPECS))])
+    check(rc_r == 0, f"restarted daemon completed the journaled work "
+                     f"and exited 0 (rc={rc_r})")
+    jr_r = (arm_r or {}).get("journal", {})
+    check(sorted(jr_r) == sorted(s["id"] for s in SPECS),
+          f"restart completed every intake-journaled request "
+          f"({sorted(jr_r)})")
+    for spec in SPECS:
+        rid, ref = spec["id"], (solo or {}).get(spec["id"], {})
+        got = jr_r.get(rid, {})
+        tag = ("replayed" if rid in committed else "recomputed")
+        check(bool(got) and got.get("snapshot") == ref.get("snapshot")
+              and got.get("dlines") == ref.get("dlines"),
+              f"{rid}: {tag} after restart, bit-identical to solo")
+    cache_stats = (arm_r or {}).get("cache", {})
+    check(cache_stats.get("misses", -1) == 0
+          and cache_stats.get("hits", 0) >= 1,
+          f"zero persistent-cache misses on restart (no recompiles): "
+          f"{cache_stats}")
+
+    print(f"  elapsed: {time.time() - t0:.1f}s")
+    if failures:
+        print(f"SERVE SMOKE FAILED ({len(failures)} invariant(s)):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("SERVE SMOKE PASSED")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

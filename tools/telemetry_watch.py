@@ -19,7 +19,10 @@ log's last ``telemetry_listen`` record.
 
 Zero dependencies beyond the stdlib; works against any run started with
 ``--telemetry-port`` (single, sweeps, lanes, origin-rank, all-origins,
-traffic, oracle).
+traffic, oracle).  Against a ``--serve`` daemon the frame adds the
+gossip-as-a-service view: lane occupancy with per-lane request
+id/tenant/progress/ETA, queue depth, per-tenant admitted/rejected
+counters, and the ledger budget reservation (serve/, ISSUE 20).
 
 Usage:
   python tools/telemetry_watch.py --url http://127.0.0.1:8321
@@ -128,6 +131,38 @@ def render_frame(url: str) -> str:
     if drops:
         lines.append("  counters: " + " ".join(
             f"{k}={int(v)}" for k, v in sorted(drops.items())))
+    # gossip-as-a-service daemon view (serve/, ISSUE 20)
+    serve = status.get("serve") or {}
+    if serve.get("enabled"):
+        lines.append(
+            f"  serve: {serve.get('busy', 0)}/{serve.get('lanes', 0)} "
+            f"lane(s) busy, {serve.get('queued', 0)} queued "
+            f"(block {serve.get('block_rounds', 0)} rounds"
+            + (", DRAINING" if serve.get("draining") else "") + ")")
+        lines.append(
+            f"    requests: {serve.get('admitted', 0)} admitted / "
+            f"{serve.get('rejected', 0)} rejected / "
+            f"{serve.get('completed', 0)} done of "
+            f"{serve.get('received', 0)} received")
+        if serve.get("budget_bytes"):
+            lines.append(
+                f"    budget: {_fmt_bytes(serve.get('bytes_in_use', 0))} "
+                f"of {_fmt_bytes(serve['budget_bytes'])} reserved")
+        adm = serve.get("tenants_admitted") or {}
+        rej = serve.get("tenants_rejected") or {}
+        for tenant in sorted(set(adm) | set(rej)):
+            lines.append(f"      {tenant}: {adm.get(tenant, 0)} admitted, "
+                         f"{rej.get(tenant, 0)} rejected")
+        for ld in serve.get("lane_detail") or []:
+            if ld.get("busy"):
+                lines.append(
+                    f"    lane {ld.get('lane')}: {ld.get('id')} "
+                    f"({ld.get('tenant')}) "
+                    f"{ld.get('rounds_done', 0)}/"
+                    f"{ld.get('total_rounds', 0)} rounds "
+                    f"ETA {_fmt_eta(ld.get('eta_s', -1))}")
+            else:
+                lines.append(f"    lane {ld.get('lane')}: idle")
     committed = m("journal_committed_units_total")
     if committed:
         lines.append(f"  journal: {int(committed)} unit(s) committed, "
